@@ -1,0 +1,110 @@
+"""paddle_tpu — a TPU-native deep learning framework with the PaddlePaddle
+API surface, built from scratch on jax/XLA/Pallas/pjit.
+
+Architecture (vs the reference at /root/reference — see SURVEY.md):
+ - eager "dygraph" execution = per-op XLA dispatch with a jax.vjp-backed
+   autograd tape (paddle_tpu.autograd.engine);
+ - static/jit path = whole-train-step functionalization compiled to one XLA
+   program (paddle_tpu.jit), replacing ProgramDesc+Executor;
+ - distributed = jax.sharding.Mesh + shard_map collectives over ICI/DCN,
+   replacing NCCL rings / ProcessGroup (paddle_tpu.distributed);
+ - hot kernels = Pallas (paddle_tpu.ops.pallas).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# framework core
+from .framework.dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    get_default_dtype,
+    set_default_dtype,
+)
+from .framework.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    get_device,
+    set_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.tensor import Parameter, Tensor, to_tensor, is_tensor  # noqa: F401
+
+# the whole tensor-op surface (also patches Tensor methods)
+from .ops import *  # noqa: F401,F403
+from .ops import add_n, einsum  # noqa: F401
+from .ops.random import (  # noqa: F401
+    bernoulli,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    standard_normal,
+    uniform,
+)
+
+from .autograd import no_grad, enable_grad, set_grad_enabled, grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# Subsystems are appended here as they land (build order in SURVEY.md §7).
+from . import nn  # noqa: F401
+from .nn.layer.container import LayerList, ParameterList, Sequential  # noqa: F401
+
+# paddle.disable_static/enable_static compat: we are always "dygraph" unless
+# tracing; these are no-ops kept for API parity.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static(place=None):
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode():
+    return not _static_mode
+
+
+def set_grad_enabled_ctx(mode):
+    return set_grad_enabled(mode)
+
+
+def is_grad_enabled():
+    from .autograd import is_grad_enabled as _ige
+
+    return _ige()
+
+
+def device_count():
+    import jax
+
+    return jax.local_device_count()
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})

@@ -1,0 +1,14 @@
+"""paddle.autograd equivalent."""
+from .engine import (  # noqa: F401
+    GradNode,
+    backward,
+    grad,
+    no_grad,
+    enable_grad,
+    set_grad_enabled,
+    is_grad_enabled,
+)
+
+
+def is_grad_enabled_fn():
+    return is_grad_enabled()

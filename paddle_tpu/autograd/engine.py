@@ -1,0 +1,314 @@
+"""Dygraph autograd engine.
+
+TPU-native redesign of the reference eager autograd
+(``paddle/fluid/eager/backward.cc:848`` ``egr::Backward`` → ``RunBackward:556``,
+node/edge model in ``eager/grad_node_info.h``): each eager op application
+records a :class:`GradNode` whose backward function is the ``jax.vjp`` closure
+of the op's XLA-lowered forward. ``backward()`` performs the same ready-queue
+traversal over the recorded graph, but every backward step is itself a jax
+computation — so the *entire* forward+backward+update loop remains traceable by
+``jax.jit`` and compiles to one fused XLA program (see paddle_tpu.jit).
+
+Differences from the reference, by design:
+ - residual storage & rematerialization are delegated to jax.vjp / jax.checkpoint
+   instead of a hand-rolled ``TensorWrapper``;
+ - there are no device streams to schedule — XLA handles async execution.
+"""
+from __future__ import annotations
+
+from collections import deque
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode",
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+]
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = bool(mode)
+    return prev
+
+
+class _GradGuard:
+    """Context manager *and* decorator, like paddle.no_grad."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn=None):
+        if fn is None:
+            return _GradGuard(self._mode)
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with _GradGuard(self._mode):
+                return fn(*a, **k)
+
+        return wrapper
+
+
+def no_grad(fn=None):
+    g = _GradGuard(False)
+    return g(fn) if callable(fn) else g
+
+
+def enable_grad(fn=None):
+    g = _GradGuard(True)
+    return g(fn) if callable(fn) else g
+
+
+class Edge:
+    """Where a produced input-cotangent flows (cf. ``egr::Edge``)."""
+
+    __slots__ = ("node", "slot", "leaf")
+
+    def __init__(self, node=None, slot=0, leaf=None):
+        self.node = node      # producer GradNode of the input tensor (or None)
+        self.slot = slot      # which output slot of that node
+        self.leaf = leaf      # leaf Tensor to accumulate .grad into (or None)
+
+
+class GradNode:
+    """One recorded op application (cf. ``egr::GradNodeBase``)."""
+
+    __slots__ = ("name", "vjp_fn", "edges", "out_info", "multi", "hooks", "__weakref__")
+
+    def __init__(self, name, vjp_fn, edges, out_info, multi):
+        self.name = name
+        self.vjp_fn = vjp_fn          # cotangents -> tuple(input cotangents)
+        self.edges = edges            # list[Edge], aligned with vjp inputs
+        self.out_info = out_info      # list[(shape, dtype)] per output slot
+        self.multi = multi            # forward returned a tuple
+        self.hooks = {}               # out_slot -> [hook fns]
+
+    def __repr__(self):
+        return f"<GradNode {self.name} outs={len(self.out_info)}>"
+
+
+def _discover(roots):
+    """Find reachable nodes and per-node in-degree (count of consumer edges)."""
+    indeg = {}
+    stack = [n for n in roots if n is not None]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        indeg.setdefault(id(node), 0)
+        for e in node.edges:
+            if e.node is not None:
+                indeg[id(e.node)] = indeg.get(id(e.node), 0) + 1
+                if id(e.node) not in seen:
+                    stack.append(e.node)
+    return indeg
+
+
+def _zeros(info):
+    shape, dtype = info
+    return jnp.zeros(shape, dtype)
+
+
+def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None):
+    """Core traversal. root_pairs: list of (tensor, seed_cotangent).
+
+    If grad_sinks is a dict {id(tensor): tensor}, gradients for those leaves are
+    returned in a dict instead of (or in addition to) .grad accumulation.
+    """
+    from ..framework.tensor import Tensor
+
+    buffers = {}   # id(node) -> list of cotangent per slot
+    nodes = {}     # id(node) -> node
+    sink_grads = {} if grad_sinks is not None else None
+    # For paddle.grad on intermediate (non-leaf) inputs: capture the assembled
+    # cotangent at the producing node's slot when that node is processed.
+    node_sinks = {}  # (id(node), slot) -> id(tensor)
+    if grad_sinks is not None:
+        for tid, t in grad_sinks.items():
+            if t._grad_node is not None:
+                node_sinks[(id(t._grad_node), t._out_slot)] = tid
+
+    root_nodes = []
+    for t, seed in root_pairs:
+        n = t._grad_node
+        if n is None:
+            # Leaf root: gradient of itself is the seed.
+            _deposit_leaf(t, seed, accumulate, grad_sinks, sink_grads)
+            continue
+        root_nodes.append(n)
+        nodes[id(n)] = n
+        buf = buffers.setdefault(id(n), [None] * len(n.out_info))
+        s = t._out_slot
+        buf[s] = seed if buf[s] is None else buf[s] + seed
+
+    indeg = _discover(root_nodes)
+    pending = dict(indeg)
+    ready = deque(n for n in {id(r): r for r in root_nodes}.values() if pending.get(id(n), 0) == 0)
+    # nodes map fill for traversal
+    stack = list(root_nodes)
+    while stack:
+        n = stack.pop()
+        for e in n.edges:
+            if e.node is not None and id(e.node) not in nodes:
+                nodes[id(e.node)] = e.node
+                stack.append(e.node)
+
+    processed = 0
+    while ready:
+        node = ready.popleft()
+        processed += 1
+        buf = buffers.get(id(node), [None] * len(node.out_info))
+        cots = [
+            b if b is not None else _zeros(info)
+            for b, info in zip(buf, node.out_info)
+        ]
+        if node_sinks:
+            for slot in range(len(node.out_info)):
+                tid = node_sinks.get((id(node), slot))
+                if tid is not None and buf[slot] is not None:
+                    sink_grads[tid] = (
+                        buf[slot] if tid not in sink_grads else sink_grads[tid] + buf[slot]
+                    )
+        # per-slot gradient hooks (tensor.register_hook on intermediate tensors)
+        for slot, hooks in node.hooks.items():
+            for h in hooks:
+                r = h(Tensor(cots[slot], stop_gradient=True))
+                if r is not None:
+                    cots[slot] = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"GradNode {node.name} was already released; call backward with "
+                "retain_graph=True to backprop through the same graph twice."
+            )
+        in_cots = node.vjp_fn(tuple(cots) if node.multi else cots[0])
+        if not retain_graph:
+            node.vjp_fn = None
+        buffers.pop(id(node), None)
+        for e, c in zip(node.edges, in_cots):
+            if e.leaf is not None:
+                _deposit_leaf(e.leaf, c, accumulate, grad_sinks, sink_grads)
+            elif e.node is not None:
+                b = buffers.setdefault(id(e.node), [None] * len(e.node.out_info))
+                b[e.slot] = c if b[e.slot] is None else b[e.slot] + c
+                pending[id(e.node)] -= 1
+                if pending[id(e.node)] == 0:
+                    ready.append(e.node)
+    return sink_grads
+
+
+def _deposit_leaf(t, cot, accumulate, grad_sinks, sink_grads):
+    from ..framework.tensor import Tensor
+
+    for h in t._hooks:
+        r = h(Tensor(cot, stop_gradient=True))
+        if r is not None:
+            cot = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+    if grad_sinks is not None:
+        # paddle.grad semantics: collect requested grads, never touch .grad.
+        if id(t) in grad_sinks:
+            sink_grads[id(t)] = (
+                cot if id(t) not in sink_grads else sink_grads[id(t)] + cot
+            )
+        return
+    t._accumulate_grad(cot)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward / Tensor.backward.
+
+    Seeds each root with its cotangent (ones for scalar losses) and runs the
+    ready-queue traversal, accumulating into leaf ``.grad``.
+    """
+    from ..framework.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    pairs = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        if g is None:
+            seed = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            seed = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        pairs.append((t, seed))
+    _run(pairs, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — functional gradient w.r.t. ``inputs`` without touching .grad.
+
+    Reference: ``GeneralGrad`` in ``paddle/fluid/eager/backward.cc:38``.
+    create_graph (double backward) is not yet supported — the jit path covers
+    higher-order via jax.grad composition instead.
+    """
+    from ..framework.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad "
+            "composition) for higher-order derivatives."
+        )
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+
+    # Temporarily divert leaf deposits for the requested inputs.
+    sinks = {id(t): t for t in inputs}
+    pairs = []
+    for t, g in zip(outputs, grad_outputs):
+        seed = (
+            jnp.ones(t._value.shape, t._value.dtype)
+            if g is None
+            else (g._value if isinstance(g, Tensor) else jnp.asarray(g))
+        )
+        pairs.append((t, seed))
+    sink_grads = _run(pairs, retain_graph=retain, accumulate=False, grad_sinks=sinks)
+    results = []
+    for t in inputs:
+        if id(t) in sink_grads:
+            results.append(Tensor(sink_grads[id(t)], stop_gradient=True))
+        elif allow_unused:
+            results.append(None)
+        else:
+            raise ValueError(
+                "One of the differentiated tensors appears to not have been used "
+                "in the graph; set allow_unused=True if this is intended."
+            )
+    return results

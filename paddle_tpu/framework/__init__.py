@@ -1,0 +1,19 @@
+from . import dtype as dtype_mod  # noqa: F401
+from .dtype import (  # noqa: F401
+    convert_dtype,
+    get_default_dtype,
+    set_default_dtype,
+)
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    get_device,
+    set_device,
+)
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor, is_tensor  # noqa: F401

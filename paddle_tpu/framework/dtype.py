@@ -1,0 +1,93 @@
+"""Dtype system.
+
+TPU-native analogue of the reference's ``phi::DataType`` (see reference
+``paddle/phi/common/data_type.h``) mapped straight onto numpy/jax dtypes.
+We keep the paddle-style string names ("float32", ...) as the canonical
+public currency, and a ``VarDesc``-style enum for compat with code that
+checks ``paddle.float32`` etc.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects are numpy dtypes (jax uses the same objects).
+bfloat16 = jnp.bfloat16
+float16 = np.dtype("float16")
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+uint8 = np.dtype("uint8")
+bool_ = np.dtype("bool")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_NAME_TO_DTYPE = {
+    "bfloat16": jnp.dtype(jnp.bfloat16),
+    "float16": float16,
+    "float32": float32,
+    "float64": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {jnp.dtype(jnp.bfloat16), float16, float32, float64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp type) to a jnp-compatible dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[dtype]
+        raise ValueError(f"unknown dtype name: {dtype}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    d = jnp.dtype(dtype)
+    if d == jnp.dtype(jnp.bfloat16):
+        return "bfloat16"
+    return d.name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.dtype(dtype) in _FLOATING
+
+
+def is_complex(dtype) -> bool:
+    return jnp.dtype(dtype) in _COMPLEX
+
+
+def is_differentiable(dtype) -> bool:
+    return is_floating(dtype) or is_complex(dtype)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype — reference python/paddle/framework/framework.py."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, float32, float64, jnp.dtype(jnp.bfloat16)):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,112 @@
+"""Places — device identity.
+
+Analogue of ``phi::Place`` (reference ``paddle/phi/common/place.h``), collapsed
+to the devices that exist in a jax process: TPU chips addressable by this host,
+plus host CPU. ``CUDAPlace`` is kept as a compat alias resolving to the
+accelerator so reference-style user code runs unchanged.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def jax_device(self):
+        return jax.local_devices(backend="cpu")[self.device_id] if _has_cpu() else jax.devices()[0]
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Compat: reference user code says CUDAPlace / set_device("gpu"); map to the
+# default jax accelerator.
+class CUDAPlace(TPUPlace):
+    pass
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type, device_id=0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+def _has_cpu():
+    try:
+        return bool(jax.local_devices(backend="cpu"))
+    except RuntimeError:
+        return False
+
+
+_current_device = None
+
+
+def _default_place() -> Place:
+    global _current_device
+    if _current_device is None:
+        backend = jax.default_backend()
+        _current_device = TPUPlace(0) if backend != "cpu" else CPUPlace(0)
+    return _current_device
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device — accepts 'cpu', 'tpu', 'tpu:0', 'gpu' (alias)."""
+    global _current_device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name == "cpu":
+        _current_device = CPUPlace(idx)
+    elif name in ("tpu", "gpu", "xpu", "npu", "mlu"):
+        _current_device = TPUPlace(idx) if jax.default_backend() != "cpu" else CPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_device
+
+
+def get_device() -> str:
+    p = _default_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def is_compiled_with_cuda() -> bool:  # compat shim
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return jax.default_backend() == "tpu"

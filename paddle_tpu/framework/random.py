@@ -1,0 +1,72 @@
+"""Global RNG state.
+
+The reference threads per-device curand generators through a global Generator
+registry (``paddle/phi/core/generator.h``); here the analogue is a process
+Generator holding a jax PRNG key that is *split* on every draw. Crucially the
+key lives as a jax array, so when a train step is functionalized
+(paddle_tpu.jit) the generator state is captured in the state pytree and the
+whole step — including dropout/random ops — stays pure and traceable.
+
+TP-aware RNG (reference ``fleet/meta_parallel/parallel_layers/random.py``
+RNGStatesTracker) is provided by ``paddle_tpu.distributed.fleet.rng_tracker``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "Generator", "default_generator", "next_key", "get_rng_state", "set_rng_state"]
+
+
+class Generator:
+    def __init__(self, seed_val: int = 0):
+        self._key = jax.random.key(seed_val)
+        self._seed = seed_val
+
+    def manual_seed(self, seed_val: int):
+        self._key = jax.random.key(int(seed_val))
+        self._seed = int(seed_val)
+        return self
+
+    def next_key(self, num: int = 1):
+        """Split the state; returns one key (num=1) or an array of keys."""
+        keys = jax.random.split(self._key, num + 1)
+        self._key = keys[0]
+        return keys[1] if num == 1 else keys[1:]
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed — reseed the global generator (and TP tracker if active)."""
+    default_generator.manual_seed(s)
+    try:
+        from ..distributed.fleet import rng_tracker
+
+        rng_tracker._reset_on_seed(s)
+    except ImportError:
+        pass
+    return default_generator
+
+
+def next_key(num: int = 1):
+    return default_generator.next_key(num)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)

@@ -1,0 +1,338 @@
+"""Eager Tensor.
+
+TPU-native analogue of the reference eager Tensor
+(``paddle/fluid/eager/`` + ``paddle/phi/core/dense_tensor.h:37``): a thin
+mutable handle over an immutable ``jax.Array`` plus autograd metadata
+(cf. ``egr::AutogradMeta`` ``eager/autograd_meta.h:61``). Mutation (inplace
+ops, ``__setitem__``, ``optimizer.step``) rebinds the underlying array —
+the functional-XLA translation of the reference's in-place kernels.
+
+Most math/manipulation methods are patched onto this class by
+``paddle_tpu.ops`` at import time, mirroring the reference's monkey-patching
+(``python/paddle/fluid/dygraph/varbase_patch_methods.py:202``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from .place import Place, _default_place
+from ..autograd import engine
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "is_tensor"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_out_slot",
+        "_hooks",
+        "name",
+        "persistable",
+        "is_leaf_param",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient=True, name=None, place=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, jax.Array) and not _is_tracer(value):
+            value = jnp.asarray(value)
+            if place is not None:
+                value = jax.device_put(value, place.jax_device())
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None       # producing GradNode (None for leaves)
+        self._out_slot = 0
+        self._hooks = []
+        self.name = name or ""
+        self.persistable = False
+        self.is_leaf_param = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    def dim(self):
+        return self._value.ndim
+
+    ndimension = dim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def place(self) -> Place:
+        return _default_place()
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is not None and not isinstance(g, Tensor):
+            g = Tensor(g, stop_gradient=True)
+        self._grad = g
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        a = np.asarray(self._value)
+        return a.item(*idx) if idx else a.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        engine.backward([self], [grad_tensor] if grad_tensor is not None else None,
+                        retain_graph=retain_graph)
+
+    def register_hook(self, hook):
+        """Run ``hook(grad)`` when this tensor's gradient is computed."""
+        if self._grad_node is not None:
+            self._grad_node.hooks.setdefault(self._out_slot, []).append(hook)
+            hooks = self._grad_node.hooks[self._out_slot]
+        else:
+            self._hooks.append(hook)
+            hooks = self._hooks
+
+        class _Handle:
+            def remove(self_h):
+                try:
+                    hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
+
+    def _accumulate_grad(self, cot):
+        if cot.dtype != self._value.dtype:
+            cot = cot.astype(self._value.dtype)
+        if self._grad is None:
+            self._grad = Tensor(cot, stop_gradient=True)
+        else:
+            self._grad._value = self._grad._value + cot
+
+    def clear_grad(self, set_to_zero=False):
+        if set_to_zero and self._grad is not None:
+            self._grad._value = jnp.zeros_like(self._grad._value)
+        else:
+            self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self._out_slot = 0
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    # -- mutation -----------------------------------------------------------
+    def set_value(self, value):
+        """In-place overwrite (reference ``Tensor.set_value``). Shape must match."""
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {v.shape} vs {self._value.shape}"
+            )
+        self._value = v.astype(self._value.dtype)
+        return self
+
+    def _rebind(self, other: "Tensor"):
+        """Adopt another tensor's value+autograd meta (inplace-op helper)."""
+        self._value = other._value
+        self._grad_node = other._grad_node
+        self._out_slot = other._out_slot
+        self.stop_gradient = other.stop_gradient
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    # -- dtype / device moves ------------------------------------------------
+    def astype(self, dt):
+        from .. import ops
+
+        return ops.cast(self, dt)
+
+    def cast(self, dt):
+        return self.astype(dt)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, device_id=None, blocking=True):
+        return self
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                continue
+            if isinstance(a, Place):
+                continue
+            try:
+                t = t.astype(dtypes.convert_dtype(a))
+            except (ValueError, TypeError):
+                pass
+        return t
+
+    def pin_memory(self):
+        return self
+
+    # -- indexing (autograd-aware; see ops.manipulation) ---------------------
+    def __getitem__(self, idx):
+        from ..ops import manipulation
+
+        return manipulation._getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from ..ops import manipulation
+
+        manipulation._setitem_(self, idx, value)
+
+    # -- repr ----------------------------------------------------------------
+    def __repr__(self):
+        if _is_tracer(self._value):
+            return (
+                f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+                f"traced, stop_gradient={self.stop_gradient})"
+            )
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._value)})"
+        )
+
+    # -- method patch point (filled by paddle_tpu.ops) -----------------------
+    @classmethod
+    def _patch_method(cls, name, fn):
+        setattr(cls, name, fn)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference ``framework.Parameter`` /
+    ``fluid/framework.py`` Parameter): stop_gradient=False by default,
+    persistable, carries optimizer attributes."""
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.is_leaf_param = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference ``python/paddle/tensor/creation.py``)."""
+    dt = dtypes.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        v = data._value
+        if dt is not None and v.dtype != dt:
+            v = v.astype(dt)
+        return Tensor(v, stop_gradient=stop_gradient, place=place)
+    if isinstance(data, (jax.Array,)) or _is_tracer(data):
+        v = data if dt is None else data.astype(dt)
+        return Tensor(v, stop_gradient=stop_gradient)
+    a = np.asarray(data)
+    if dt is None:
+        # paddle semantics: python floats -> default dtype; ints -> int64
+        if a.dtype == np.float64 and isinstance(data, (float, list, tuple)):
+            a = a.astype(dtypes.get_default_dtype())
+        elif a.dtype == np.int64 and isinstance(data, (int, bool)):
+            pass
+    else:
+        a = a.astype(dt) if dt != jnp.dtype(jnp.bfloat16) else a
+    v = jnp.asarray(a, dtype=dt)
+    if place is not None:
+        v = jax.device_put(v, place.jax_device())
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

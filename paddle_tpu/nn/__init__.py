@@ -1,0 +1,13 @@
+"""paddle.nn equivalent (reference ``python/paddle/nn/__init__.py``)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+
+from ..utils.clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401

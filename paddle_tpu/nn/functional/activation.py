@@ -1,0 +1,239 @@
+"""Activation functionals (reference ``python/paddle/nn/functional/activation.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import op
+from ...framework.tensor import Tensor
+
+relu = op("relu")(lambda x: jnp.maximum(x, 0))
+relu6 = op("relu6")(lambda x: jnp.clip(x, 0, 6))
+sigmoid = op("sigmoid")(lambda x: jax.nn.sigmoid(x))
+tanh = op("tanh_act")(lambda x: jnp.tanh(x))
+silu = op("silu")(lambda x: jax.nn.silu(x))
+swish = silu
+mish = op("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = op("tanhshrink")(lambda x: x - jnp.tanh(x))
+
+
+@op("gelu")
+def _gelu_raw(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu_raw(x, approximate=approximate)
+
+
+@op("leaky_relu")
+def _leaky_relu_raw(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu_raw(x, negative_slope=negative_slope)
+
+
+@op("elu")
+def _elu_raw(x, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu_raw(x, alpha=alpha)
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(_elu_raw(x, alpha=alpha))
+
+
+@op("celu")
+def _celu_raw(x, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu_raw(x, alpha=alpha)
+
+
+@op("selu")
+def _selu_raw(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu_raw(x, scale=scale, alpha=alpha)
+
+
+@op("hardshrink")
+def _hardshrink_raw(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink_raw(x, threshold=threshold)
+
+
+@op("softshrink")
+def _softshrink_raw(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink_raw(x, threshold=threshold)
+
+
+@op("hardtanh")
+def _hardtanh_raw(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh_raw(x, min=min, max=max)
+
+
+@op("hardsigmoid")
+def _hardsigmoid_raw(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _hardsigmoid_raw(x, slope=slope, offset=offset)
+
+
+@op("hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@op("softplus_op")
+def _softplus_raw(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    return jnp.where(bx > threshold, x, jnp.log1p(jnp.exp(jnp.minimum(bx, threshold))) / beta)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus_raw(x, beta=beta, threshold=threshold)
+
+
+@op("softsign")
+def softsign(x, name=None):
+    return x / (1.0 + jnp.abs(x))
+
+
+@op("logsigmoid")
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@op("softmax_op")
+def _softmax_raw(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _softmax_raw(x, axis=int(axis))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+@op("log_softmax_op")
+def _log_softmax_raw(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    return _log_softmax_raw(x, axis=int(axis))
+
+
+@op("gumbel_softmax_op")
+def _gumbel_softmax_raw(x, g, temperature=1.0, axis=-1):
+    return jax.nn.softmax((x + g) / temperature, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as rnd
+
+    g = jax.random.gumbel(rnd.next_key(), tuple(x.shape), x._value.dtype)
+    y = _gumbel_softmax_raw(x, Tensor(g), temperature=temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y._value, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y._value)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+        # straight-through estimator
+        from ...ops import math as m
+
+        return m.add(Tensor(onehot - jax.lax.stop_gradient(y._value)), y)
+    return y
+
+
+@op("maxout_op")
+def _maxout_raw(x, groups=2, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout_raw(x, groups=groups, axis=axis)
+
+
+@op("thresholded_relu_op")
+def _thresholded_relu_raw(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _thresholded_relu_raw(x, threshold=threshold, value=value)
+
+
+@op("prelu_op")
+def _prelu_raw(x, weight, data_format="NCHW"):
+    if weight.size == 1:
+        w = weight.reshape(())
+    else:
+        c_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[c_axis] = weight.size
+        w = weight.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu_raw(x, weight, data_format=data_format)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from ...framework import random as rnd
+
+        a = jax.random.uniform(rnd.next_key(), tuple(x.shape), x._value.dtype, lower, upper)
+        return _prelu_like(x, Tensor(a))
+    return _leaky_relu_raw(x, negative_slope=(lower + upper) / 2.0)
+
+
+@op("rrelu_train")
+def _prelu_like(x, alpha):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+def glu(x, axis=-1, name=None):
+    return _glu_raw(x, axis=axis)
+
+
+@op("glu_op")
+def _glu_raw(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)

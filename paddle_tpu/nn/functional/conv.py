@@ -1,0 +1,213 @@
+"""Convolutions (reference ``python/paddle/nn/functional/conv.py``; CUDA path
+``paddle/phi/kernels/gpudnn/conv_kernel.cu``). Here a single
+``lax.conv_general_dilated`` lowering — XLA tiles it onto the MXU and picks
+the layout; we keep paddle's NCHW-default API."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import op
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = list(v)
+    if len(v) == 1:
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(x) for x in v)
+
+
+def _norm_padding(padding, n):
+    """Returns (lax padding spec, is_same)."""
+    if isinstance(padding, str):
+        return padding.upper(), True
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n, False
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding], False
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)], False
+    # nested [[l, r], ...] possibly including batch/channel dims
+    flat = [list(p) if isinstance(p, (list, tuple)) else [p, p] for p in padding]
+    if len(flat) == n + 2:
+        flat = flat[2:] if flat[0] == [0, 0] else flat[-n:]
+    return [(int(l), int(r)) for l, r in flat[:n]], False
+
+
+def _dim_numbers(nd, channel_last):
+    if nd == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if nd == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+@op("conv_nd")
+def _conv_raw(
+    x,
+    weight,
+    bias=None,
+    stride=(1,),
+    padding="VALID",
+    dilation=(1,),
+    groups=1,
+    channel_last=False,
+    nd=2,
+):
+    # paddle weight layout is always [out_c, in_c/groups, *k] (OIHW);
+    # transpose for channel-last spec
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(nd, channel_last)
+    if channel_last:
+        # OIHW -> HWIO
+        perm = list(range(2, 2 + nd)) + [1, 0]
+        weight = jnp.transpose(weight, perm)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=stride,
+        padding=padding,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd):
+    channel_last = data_format.endswith("C")
+    pad_spec, _ = _norm_padding(padding, nd)
+    return _conv_raw(
+        x,
+        weight,
+        *([bias] if bias is not None else []),
+        stride=_norm_tuple(stride, nd),
+        padding=pad_spec,
+        dilation=_norm_tuple(dilation, nd),
+        groups=groups,
+        channel_last=channel_last,
+        nd=nd,
+    )
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, df, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+@op("conv_transpose_nd")
+def _conv_transpose_raw(
+    x,
+    weight,
+    bias=None,
+    stride=(1,),
+    padding=((0, 0),),
+    output_padding=(0,),
+    dilation=(1,),
+    groups=1,
+    channel_last=False,
+    nd=2,
+):
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    lhs_spec, rhs_spec, out_spec = _dim_numbers(nd, channel_last)
+    # Build transposed conv as lhs-dilated conv (the standard XLA lowering):
+    # flip spatial dims of the kernel and swap I/O.
+    spatial_axes = tuple(range(2, 2 + nd))
+    w = jnp.flip(weight, spatial_axes)  # [in_c, out_c/groups, *k]
+    if groups > 1:
+        # [g*icg, ocg, *k] -> [g*ocg, icg, *k]
+        icg = w.shape[0] // groups
+        ocg = w.shape[1]
+        w = w.reshape(groups, icg, ocg, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * ocg, icg, *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)  # [out_c, in_c, *k]
+    kernel_spatial = w.shape[2:]  # OIHW layout here
+    if channel_last:
+        perm = list(range(2, 2 + nd)) + [1, 0]
+        w = jnp.transpose(w, perm)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, (lhs_spec, rhs_spec, out_spec))
+    if isinstance(padding, str):
+        raise ValueError("SAME padding unsupported for conv_transpose; pass ints")
+    # effective padding for the dilated-input conv
+    eff_pad = []
+    for i in range(nd):
+        ke = dilation[i] * (kernel_spatial[i] - 1) + 1
+        pl, pr = padding[i]
+        eff_pad.append((ke - 1 - pl, ke - 1 - pr + output_padding[i]))
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=(1,) * nd,
+        padding=eff_pad,
+        lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        bshape = [1] * out.ndim
+        bshape[out.ndim - 1 if channel_last else 1] = bias.shape[0]
+        out = out + bias.reshape(bshape)
+    return out
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, nd, output_size=None):
+    channel_last = data_format.endswith("C")
+    pad_spec, is_same = _norm_padding(padding, nd)
+    if is_same:
+        raise NotImplementedError("string padding for conv_transpose")
+    st = _norm_tuple(stride, nd)
+    dl = _norm_tuple(dilation, nd)
+    opd = _norm_tuple(output_padding, nd)
+    if output_size is not None:
+        # derive output_padding from requested size
+        spatial_in = x.shape[1:-1] if channel_last else x.shape[2:]
+        k = weight.shape[2:]
+        os_ = output_size if isinstance(output_size, (list, tuple)) else [output_size] * nd
+        opd = tuple(
+            int(os_[i]) - ((spatial_in[i] - 1) * st[i] - pad_spec[i][0] - pad_spec[i][1] + dl[i] * (k[i] - 1) + 1)
+            for i in range(nd)
+        )
+    return _conv_transpose_raw(
+        x,
+        weight,
+        *([bias] if bias is not None else []),
+        stride=st,
+        padding=tuple(pad_spec),
+        output_padding=opd,
+        dilation=dl,
+        groups=groups,
+        channel_last=channel_last,
+        nd=nd,
+    )
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, df, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 3, output_size)

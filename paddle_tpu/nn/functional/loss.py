@@ -1,0 +1,455 @@
+"""Loss functionals (reference ``python/paddle/nn/functional/loss.py``;
+softmax+CE fused kernel ``paddle/phi/kernels/gpu/cross_entropy_kernel.cu`` —
+here the log-softmax+gather form which XLA fuses)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import op, ensure_tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@op("softmax_ce")
+def _softmax_ce_raw(logits, label, soft_label=False, axis=-1, ignore_index=-100,
+                    use_ignore=False, reduction="none", ls_epsilon=0.0):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        tgt = label
+        if ls_epsilon > 0.0:
+            n = logits.shape[axis]
+            tgt = (1 - ls_epsilon) * tgt + ls_epsilon / n
+        loss = -jnp.sum(tgt * logp, axis=axis)
+    else:
+        lab = label
+        if lab.ndim == logp.ndim:
+            lab = jnp.squeeze(lab, axis)
+        lab_i = lab.astype(jnp.int32)
+        safe = jnp.where(lab_i < 0, 0, lab_i)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis)
+        if use_ignore:
+            mask = lab_i != ignore_index
+            loss = jnp.where(mask, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    if not use_softmax:
+        return nll_loss_from_probs(input, label, weight, ignore_index, reduction, soft_label, axis)
+    if weight is not None:
+        # weighted path: per-class weights gathered by label
+        logp = log_softmax_t(input, axis)
+        lab = label
+        if lab.ndim == input.ndim:
+            from ...ops import manipulation as man
+
+            lab = man.squeeze(lab, axis)
+        return _weighted_nll(logp, lab, weight, ignore_index=ignore_index, reduction=reduction, axis=axis)
+    return _softmax_ce_raw(
+        input,
+        label,
+        soft_label=soft_label,
+        axis=int(axis),
+        ignore_index=ignore_index,
+        use_ignore=not soft_label,
+        reduction=reduction,
+        ls_epsilon=label_smoothing,
+    )
+
+
+def log_softmax_t(x, axis):
+    from .activation import log_softmax
+
+    return log_softmax(x, axis)
+
+
+@op("weighted_nll")
+def _weighted_nll(logp, label, weight, ignore_index=-100, reduction="mean", axis=-1):
+    lab_i = label.astype(jnp.int32)
+    safe = jnp.where(lab_i < 0, 0, lab_i)
+    picked = -jnp.squeeze(jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis), axis)
+    w = jnp.take(weight, safe)
+    mask = (lab_i != ignore_index).astype(logp.dtype)
+    loss = picked * w * mask
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w * mask), 1e-12)
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = _softmax_ce_raw(logits, label, soft_label=soft_label, axis=int(axis), ignore_index=ignore_index, use_ignore=not soft_label, reduction="none")
+    from ...ops import manipulation as man
+
+    loss = man.unsqueeze(loss, axis)
+    if return_softmax:
+        from .activation import softmax
+
+        return loss, softmax(logits, axis)
+    return loss
+
+
+def nll_loss_from_probs(input, label, weight, ignore_index, reduction, soft_label, axis):
+    from ...ops import math as m
+
+    logp = m.log(input)
+    if soft_label:
+        return _soft_nll(logp, label, reduction=reduction, axis=axis)
+    if weight is not None:
+        return _weighted_nll(logp, label, weight, ignore_index=ignore_index, reduction=reduction, axis=axis)
+    return nll_loss(logp, label, reduction=reduction, ignore_index=ignore_index)
+
+
+@op("soft_nll")
+def _soft_nll(logp, label, reduction="mean", axis=-1):
+    loss = -jnp.sum(label * logp, axis=axis)
+    return _reduce(loss, reduction)
+
+
+@op("nll_loss_op")
+def _nll_raw(logp, label, ignore_index=-100, reduction="mean", has_weight=False, weight=None):
+    lab = label.astype(jnp.int32)
+    safe = jnp.where(lab < 0, 0, lab)
+    # class axis is 1 for nll_loss (N, C, ...)
+    picked = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+    picked = jnp.squeeze(picked, 1)
+    mask = (lab != ignore_index).astype(logp.dtype)
+    if has_weight:
+        w = jnp.take(weight, safe) * mask
+        loss = picked * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+        return _reduce(loss, reduction)
+    loss = picked * mask
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    if weight is not None:
+        return _nll_weighted_raw(input, label, weight, ignore_index=ignore_index, reduction=reduction)
+    return _nll_raw(input, label, ignore_index=ignore_index, reduction=reduction)
+
+
+@op("nll_loss_weighted")
+def _nll_weighted_raw(logp, label, weight, ignore_index=-100, reduction="mean"):
+    lab = label.astype(jnp.int32)
+    safe = jnp.where(lab < 0, 0, lab)
+    picked = -jnp.squeeze(jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1), 1)
+    mask = (lab != ignore_index).astype(logp.dtype)
+    w = jnp.take(weight, safe) * mask
+    loss = picked * w
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    return _reduce(loss, reduction)
+
+
+@op("mse_loss_op")
+def _mse_raw(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse_raw(input, label, reduction=reduction)
+
+
+@op("l1_loss_op")
+def _l1_raw(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1_raw(input, label, reduction=reduction)
+
+
+@op("smooth_l1_op")
+def _smooth_l1_raw(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1_raw(input, label, reduction=reduction, delta=delta)
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    return _smooth_l1_raw(input, label, reduction=reduction, delta=delta)
+
+
+@op("bce_op")
+def _bce_raw(input, label, reduction="mean", has_weight=False, weight=None, eps=1e-12):
+    loss = -(label * jnp.log(jnp.maximum(input, eps)) + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if has_weight:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    if weight is not None:
+        return _bce_raw(input, label, weight, reduction=reduction, has_weight=True)
+    return _bce_raw(input, label, reduction=reduction)
+
+
+@op("bce_logits_op")
+def _bce_logits_raw(logit, label, reduction="mean", has_weight=False, weight=None, has_pos=False, pos_weight=None):
+    max_val = jnp.maximum(-logit, 0)
+    if has_pos:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if has_weight:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    args = [logit, label]
+    kwargs = dict(reduction=reduction)
+    if weight is not None:
+        args.append(weight)
+        kwargs["has_weight"] = True
+    if pos_weight is not None:
+        args.append(pos_weight)
+        kwargs["has_pos"] = True
+    # positional protocol: rebuild raw call with keywords mapping
+    if weight is not None and pos_weight is not None:
+        return _bce_logits_full(logit, label, weight, pos_weight, reduction=reduction)
+    if weight is not None:
+        return _bce_logits_w(logit, label, weight, reduction=reduction)
+    if pos_weight is not None:
+        return _bce_logits_p(logit, label, pos_weight, reduction=reduction)
+    return _bce_logits_raw(logit, label, reduction=reduction)
+
+
+@op("bce_logits_w")
+def _bce_logits_w(logit, label, weight, reduction="mean"):
+    max_val = jnp.maximum(-logit, 0)
+    loss = ((1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val) * weight
+    return _reduce(loss, reduction)
+
+
+@op("bce_logits_p")
+def _bce_logits_p(logit, label, pos_weight, reduction="mean"):
+    max_val = jnp.maximum(-logit, 0)
+    log_w = (pos_weight - 1.0) * label + 1.0
+    loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    return _reduce(loss, reduction)
+
+
+@op("bce_logits_full")
+def _bce_logits_full(logit, label, weight, pos_weight, reduction="mean"):
+    max_val = jnp.maximum(-logit, 0)
+    log_w = (pos_weight - 1.0) * label + 1.0
+    loss = ((1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)) * weight
+    return _reduce(loss, reduction)
+
+
+@op("kl_div_op")
+def _kl_raw(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _kl_raw(input, label, reduction=reduction)
+
+
+@op("margin_ranking_op")
+def _margin_ranking_raw(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0)
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return _margin_ranking_raw(input, other, label, margin=margin, reduction=reduction)
+
+
+@op("hinge_embedding_op")
+def _hinge_embedding_raw(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(margin - input, 0))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding_raw(input, label, margin=margin, reduction=reduction)
+
+
+@op("cosine_embedding_op")
+def _cosine_embedding_raw(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, -1) / (
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1) + 1e-12
+    )
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    return _cosine_embedding_raw(input1, input2, label, margin=margin, reduction=reduction)
+
+
+@op("triplet_margin_op")
+def _triplet_raw(anchor, positive, negative, margin=1.0, p=2.0, eps=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + eps) ** p, axis=-1) ** (1.0 / p)
+
+    d_pos = dist(anchor, positive)
+    d_neg = dist(anchor, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(d_pos - d_neg + margin, 0), reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _triplet_raw(input, positive, negative, margin=margin, p=p, eps=epsilon, swap=swap, reduction=reduction)
+
+
+@op("square_error_cost_op")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@op("sigmoid_focal_op")
+def _sigmoid_focal_raw(logit, label, gamma=2.0, alpha=0.25, normalizer=None, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    if normalizer is not None:
+        return _sigmoid_focal_n(logit, label, normalizer, gamma=gamma, alpha=alpha, reduction=reduction)
+    return _sigmoid_focal_raw(logit, label, gamma=gamma, alpha=alpha, reduction=reduction)
+
+
+@op("sigmoid_focal_n")
+def _sigmoid_focal_n(logit, label, normalizer, gamma=2.0, alpha=0.25, reduction="sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce / normalizer
+    return _reduce(loss, reduction)
+
+
+@op("log_loss_op")
+def _log_loss_raw(input, label, epsilon=1e-4):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss_raw(input, label, epsilon=epsilon)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC loss via dynamic-programming in log space (reference uses warpctc —
+    ``paddle/fluid/operators/warpctc_op.cc``). Implemented as a jax scan so it
+    compiles on TPU."""
+    return _ctc_raw(
+        log_probs, labels, input_lengths, label_lengths, blank=blank, reduction=reduction
+    )
+
+
+@op("ctc_op")
+def _ctc_raw(logits, labels, input_lengths, label_lengths, blank=0, reduction="mean"):
+    # logits: (T, B, C) paddle layout, raw (unnormalized); labels (B, S)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    T, B, C = logp.shape
+    S = labels.shape[1]
+    # extended label seq: blank, l1, blank, l2, ... blank  (length 2S+1)
+    ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+    ext_len = 2 * label_lengths.astype(jnp.int32) + 1
+    NEG = -1e30
+
+    # alpha recursion
+    alpha0 = jnp.full((B, 2 * S + 1), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(S > 0, logp[0, jnp.arange(B), ext[:, 1]], NEG)
+    )
+
+    same_as_prev2 = jnp.concatenate(
+        [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+
+    def step(alpha, logp_t):
+        a_prev = alpha
+        a_shift1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_shift2 = jnp.where(same_as_prev2, NEG, a_shift2)
+        combined = jnp.logaddexp(jnp.logaddexp(a_prev, a_shift1), a_shift2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        return combined + emit, None
+
+    def scan_step(carry, t):
+        alpha = carry
+        new_alpha, _ = step(alpha, logp[t])
+        # freeze past input_lengths
+        active = (t < input_lengths)[:, None]
+        alpha = jnp.where(active, new_alpha, alpha)
+        return alpha, None
+
+    alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+    idx_last = ext_len - 1
+    ll_blank = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    ll_label = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+    loss = -jnp.logaddexp(ll_blank, ll_label)
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from ...ops import math as m
+
+    B = anchor.shape[0]
+    sim = m.matmul(anchor, positive, transpose_y=True)
+    lab = labels.reshape([-1, 1])
+    tgt = (lab == lab.T).astype(sim.dtype)
+    tgt = tgt / tgt.sum(axis=1, keepdim=True)
+    ce = cross_entropy(sim, tgt, soft_label=True)
+    l2 = m.mean(m.sum(m.square(anchor), axis=1)) + m.mean(m.sum(m.square(positive), axis=1))
+    return ce + m.multiply(l2, l2_reg * 0.25)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    from ...ops import math as m
+    from .common import one_hot
+
+    lab = one_hot(label.squeeze(-1), input.shape[-1])
+    inter = m.sum(m.multiply(input, lab), axis=tuple(range(1, input.ndim)))
+    union = m.sum(input, axis=tuple(range(1, input.ndim))) + m.sum(lab, axis=tuple(range(1, lab.ndim)))
+    dice = m.divide(m.multiply(inter, 2.0), m.add(union, epsilon))
+    return m.mean(m.subtract(ensure_tensor(1.0, like=dice), dice))

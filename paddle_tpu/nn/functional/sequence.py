@@ -1,0 +1,15 @@
+"""Sequence utilities (reference LoD sequence ops are descoped — variable-length
+batches are padding+mask based on TPU, see SURVEY.md §7 'Dynamic shapes')."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework import dtype as dtypes
+from ...framework.tensor import Tensor
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    lv = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    m = int(maxlen) if maxlen is not None else int(lv.max())
+    mask = jnp.arange(m) < lv[..., None]
+    return Tensor(mask.astype(dtypes.convert_dtype(dtype)))

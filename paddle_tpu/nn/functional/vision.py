@@ -1,0 +1,72 @@
+"""Vision functionals: affine_grid / grid_sample
+(reference ``python/paddle/nn/functional/vision.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.dispatch import op
+
+
+@op("affine_grid_op")
+def _affine_grid_raw(theta, out_shape=(), align_corners=True):
+    n, c, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    grid = jnp.einsum("bij,bnj->bni", theta, jnp.broadcast_to(base, (theta.shape[0], h * w, 3)))
+    return grid.reshape(theta.shape[0], h, w, 2)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    return _affine_grid_raw(theta, out_shape=tuple(int(s) for s in out_shape), align_corners=align_corners)
+
+
+@op("grid_sample_op")
+def _grid_sample_raw(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        ix = (gx + 1) * (w - 1) / 2
+        iy = (gy + 1) * (h - 1) / 2
+    else:
+        ix = ((gx + 1) * w - 1) / 2
+        iy = ((gy + 1) * h - 1) / 2
+
+    def sample(ix_, iy_):
+        ix_c = jnp.clip(ix_, 0, w - 1)
+        iy_c = jnp.clip(iy_, 0, h - 1)
+        valid = ((ix_ >= 0) & (ix_ <= w - 1) & (iy_ >= 0) & (iy_ <= h - 1)).astype(x.dtype)
+        bidx = jnp.arange(n).reshape(n, 1, 1)
+        vals = x[bidx, :, iy_c.astype(jnp.int32), ix_c.astype(jnp.int32)]
+        if padding_mode == "zeros":
+            vals = vals * valid[..., None]
+        return vals  # (n, gh, gw, c)
+
+    if mode == "nearest":
+        out = sample(jnp.round(ix), jnp.round(iy))
+    else:
+        x0, y0 = jnp.floor(ix), jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - ix) * (y1 - iy)
+        wb = (x1 - ix) * (iy - y0)
+        wc = (ix - x0) * (y1 - iy)
+        wd = (ix - x0) * (iy - y0)
+        out = (
+            sample(x0, y0) * wa[..., None]
+            + sample(x0, y1) * wb[..., None]
+            + sample(x1, y0) * wc[..., None]
+            + sample(x1, y1) * wd[..., None]
+        )
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    return _grid_sample_raw(x, grid, mode=mode, padding_mode=padding_mode, align_corners=align_corners)

@@ -1,0 +1,379 @@
+"""Layer — the module system.
+
+Reference: ``python/paddle/fluid/dygraph/layers.py:84`` ``class Layer``
+(parameters/buffers/sublayers registries, hooks, state_dict, train/eval).
+TPU-native difference: parameters hold jax arrays; the whole tree is
+pytree-flattenable (paddle_tpu.jit) so a Layer can be captured into a single
+compiled XLA train step without touching user code.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework import dtype as dtypes
+from ...framework.tensor import Parameter, Tensor
+from ..initializer import _apply_initializer, Constant
+
+__all__ = ["Layer", "ParamAttr"]
+
+
+class ParamAttr:
+    """reference ``python/paddle/fluid/param_attr.py``."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        do_model_average=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        # an Initializer instance
+        return ParamAttr(initializer=attr)
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+_layer_name_counters = {}
+
+
+def _unique_layer_name(prefix):
+    n = _layer_name_counters.get(prefix, 0)
+    _layer_name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._full_name = _unique_layer_name(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._dtype = dtypes.convert_dtype(dtype)
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+
+    # -- construction helpers ------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtypes.convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None and is_bias:
+            init = Constant(0.0)
+        value = _apply_initializer(init, shape, dtype, is_bias=is_bias)
+        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        t = Tensor(jnp.zeros([], dtypes.convert_dtype(dtype) or self._dtype))
+        t.persistable = bool(persistable)
+        return t
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self.__dict__.pop(name, None)  # buffer lookups must route via __getattr__
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        return tensor
+
+    # -- attribute routing (reference layers.py __setattr__) -----------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params[name] = None
+                    object.__setattr__(self, name, None)
+                    return
+                if isinstance(value, Tensor):
+                    params[name].set_value(value)
+                    return
+                params.pop(name)
+            if layers is not None and name in layers and not isinstance(value, Layer):
+                layers.pop(name)
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+                buffers.pop(name)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal -----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, sub in [("", self)] + (
+            list(self._named_sublayers_recursive(prefix)) if include_sublayers else []
+        ):
+            for pname, p in sub._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                full = f"{name}.{pname}" if name else (f"{prefix}.{pname}" if prefix else pname)
+                yield full, p
+
+    def _named_sublayers_recursive(self, prefix=""):
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            full = f"{prefix}.{name}" if prefix else name
+            yield full, sub
+            yield from sub._named_sublayers_recursive(full)
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        yield from self._named_sublayers_recursive(prefix)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += list(self._named_sublayers_recursive(prefix))
+        for name, sub in layers:
+            for bname, b in sub._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            # skip non-persistable buffers (reference layers.py state_dict)
+            parts = name.rsplit(".", 1)
+            owner = self
+            if len(parts) == 2:
+                for seg in parts[0].split("."):
+                    owner = owner._sub_layers.get(seg, owner)
+                bname = parts[1]
+            else:
+                bname = name
+            if isinstance(owner, Layer) and bname in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                tgt = own[k]
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(val.shape) != tuple(tgt._value.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: {val.shape} vs {tgt._value.shape}"
+                    )
+                tgt._value = val.astype(tgt._value.dtype)
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device moves ------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._to_dtype(dtypes.convert_dtype(dtype))
+        return self
+
+    def _to_dtype(self, dt):
+        for p in self.parameters():
+            if dtypes.is_floating(p.dtype):
+                p._value = p._value.astype(dt)
+        for b in self.buffers():
+            if b is not None and dtypes.is_floating(b.dtype):
+                b._value = b._value.astype(dt)
+        for l in self.sublayers(include_self=True):
+            l._dtype = dt
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{self.__class__.__name__}({extra}"]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._sub_layers) + list(self._buffers)

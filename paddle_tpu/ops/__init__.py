@@ -1,0 +1,155 @@
+"""Op surface aggregation + Tensor method patching.
+
+Mirrors the reference's approach of assembling ``paddle.*`` tensor functions
+from per-theme modules (``python/paddle/tensor/__init__.py``) and
+monkey-patching them as Tensor methods
+(``fluid/dygraph/varbase_patch_methods.py``)."""
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+from .dispatch import OP_REGISTRY, ensure_tensor, op
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from . import random  # noqa: F401
+from .random import (  # noqa: F401
+    rand,
+    randn,
+    randint,
+    randint_like,
+    randperm,
+    uniform,
+    normal,
+    standard_normal,
+    bernoulli,
+    multinomial,
+    poisson,
+)
+
+from . import creation, math, manipulation, logic, linalg, search, stat  # noqa: F401
+
+
+# --------------------------------------------------------------------------
+# Tensor method patching
+# --------------------------------------------------------------------------
+
+import types as _types
+
+_METHODS = {}
+for _mod in (creation, math, manipulation, logic, linalg, search, stat):
+    for _name in dir(_mod):
+        if _name.startswith("_") or not _name[0].islower():
+            continue
+        _fn = getattr(_mod, _name)
+        if callable(_fn) and not isinstance(_fn, (type, _types.ModuleType)):
+            _METHODS.setdefault(_name, _fn)
+_METHODS["einsum"] = einsum
+for _name in ("uniform_", "normal_", "exponential_", "bernoulli", "multinomial"):
+    _METHODS[_name] = getattr(random, _name)
+
+_SKIP = {"is_tensor", "create_parameter", "meshgrid", "broadcast_tensors", "ensure_tensor", "op"}
+for _name, _fn in _METHODS.items():
+    if _name in _SKIP or hasattr(Tensor, _name):
+        continue
+    Tensor._patch_method(_name, _fn)
+
+# `abs`/`all` etc shadow builtins in module scope but are fine as methods.
+Tensor._patch_method("pow", lambda self, y: math.pow_(self, y))
+Tensor._patch_method("mean", math.mean)
+Tensor._patch_method("scale", math.scale)
+Tensor._patch_method("add_n", lambda self, xs: add_n([self] + list(xs)))
+
+
+def add_n(inputs, name=None):
+    """paddle.add_n — sum of a tensor list (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = math.add(out, t)
+    return out
+
+
+# in-place arithmetic variants (reference exposes add_/subtract_/scale_ etc.)
+def _make_inplace(fn):
+    def inplace(self, *a, **k):
+        return self._rebind(fn(self, *a, **k))
+
+    return inplace
+
+
+for _n, _f in (
+    ("add_", math.add),
+    ("subtract_", math.subtract),
+    ("multiply_", math.multiply),
+    ("divide_", math.divide),
+    ("clip_", math.clip),
+    ("scale_", math.scale),
+    ("floor_", math.floor),
+    ("ceil_", math.ceil),
+    ("exp_", math.exp),
+    ("sqrt_", math.sqrt),
+    ("rsqrt_", math.rsqrt),
+    ("reciprocal_", math.reciprocal),
+    ("round_", math.round),
+    ("tanh_", math.tanh),
+    ("abs_", math.abs),
+    ("remainder_", math.remainder),
+    ("pow_", math.pow_),
+):
+    Tensor._patch_method(_n, _make_inplace(_f))
+
+
+def fill_(self, value):
+    import jax.numpy as jnp
+
+    self._value = jnp.full_like(self._value, value)
+    return self
+
+
+def zero_(self):
+    return fill_(self, 0)
+
+
+Tensor._patch_method("fill_", fill_)
+Tensor._patch_method("zero_", zero_)
+
+# ---------------------------------------------------------------- dunders ---
+
+_BINOPS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(y, x) if isinstance(y, Tensor) else math.add(x, y),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: math.subtract(ensure_tensor(y, like=x), x),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: math.multiply(x, y),
+    "__truediv__": math.divide,
+    "__rtruediv__": lambda x, y: math.divide(ensure_tensor(y, like=x), x),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda x, y: math.floor_divide(ensure_tensor(y, like=x), x),
+    "__mod__": math.remainder,
+    "__pow__": math.pow_,
+    "__rpow__": lambda x, y: math.pow_(ensure_tensor(y, like=x), x),
+    "__matmul__": math.matmul,
+    "__rmatmul__": lambda x, y: math.matmul(ensure_tensor(y), x),
+    "__eq__": math.equal,
+    "__ne__": math.not_equal,
+    "__lt__": math.less_than,
+    "__le__": math.less_equal,
+    "__gt__": math.greater_than,
+    "__ge__": math.greater_equal,
+    "__and__": logic.bitwise_and,
+    "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+}
+for _n, _f in _BINOPS.items():
+    Tensor._patch_method(_n, _f)
+
+Tensor._patch_method("__neg__", math.neg)
+Tensor._patch_method("__abs__", math.abs)
+Tensor._patch_method("__invert__", logic.bitwise_not)

@@ -1,0 +1,170 @@
+"""Tensor creation ops (reference ``python/paddle/tensor/creation.py``)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor, to_tensor
+from .dispatch import op
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = (
+            dtypes.get_default_dtype()
+            if isinstance(fill_value, float)
+            else ("int64" if isinstance(fill_value, (int, bool)) else None)
+        )
+    return Tensor(jnp.full(_shape_list(shape), fill_value, _dt(dtype)))
+
+
+@op("zeros_like")
+def _zeros_like_raw(x):
+    return jnp.zeros_like(x)
+
+
+def zeros_like(x, dtype=None, name=None):
+    t = _zeros_like_raw(x)
+    return t.astype(dtype) if dtype is not None else t
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype, x._value.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(x._value.shape, fill_value, _dt(dtype, x._value.dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = val(start), val(end), val(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else dtypes.get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(val(start), val(stop), int(val(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def val(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(
+        jnp.logspace(val(start), val(stop), int(val(num)), base=val(base), dtype=_dt(dtype))
+    )
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[a._value for a in arrays], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+@op("diag")
+def _diag_raw(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0 and x.ndim == 1:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, x._value.dtype)
+        out = base + (jnp.diag(x._value, k=offset) - jnp.diag(jnp.zeros(x.shape[0], x._value.dtype), k=offset))
+        return Tensor(out)
+    return _diag_raw(x, offset=offset)
+
+
+@op("diagflat")
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+@op("assign")
+def assign(x):
+    return jnp.asarray(x)
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    return Tensor(real._value + 1j * imag._value)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    from ..framework.tensor import Parameter
+    from ..nn.initializer import _apply_initializer
+
+    value = _apply_initializer(default_initializer, shape, _dt(dtype), is_bias=is_bias)
+    return Parameter(value, name=name)

@@ -1,0 +1,124 @@
+"""Op dispatch: the eager kernel-invocation path.
+
+TPU-native replacement for the reference dispatch stack
+(generated dygraph functions → ``paddle::experimental::*`` API →
+``phi::KernelFactory::SelectKernelOrThrowError`` ``phi/core/kernel_factory.h:261``
+→ per-backend phi kernel): here every op is ONE jax-traceable python function
+lowered by XLA, so backend selection, dtype keys, and stream scheduling all
+disappear. What remains is exactly the part the reference generates per-op
+(``eager/auto_code_generator/final_state_generator/eager_gen.py:883``):
+unwrap tensors, decide whether grad is needed, run the forward, and record a
+GradNode whose backward fn is the op's ``jax.vjp``.
+
+Ops are declared with :func:`op` on a raw-jnp forward; the wrapper handles
+Tensor↔array conversion + autograd recording. The registry doubles as the
+"op table" (analogue of phi's yaml op list) for introspection and codegen.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.engine import Edge, GradNode, is_grad_enabled
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor
+
+OP_REGISTRY = {}
+
+
+def _needs_grad(t: Tensor) -> bool:
+    return (not t.stop_gradient) and dtypes.is_differentiable(t.dtype)
+
+
+def _leaf_edge(t: Tensor) -> Edge:
+    if t._grad_node is not None:
+        return Edge(node=t._grad_node, slot=t._out_slot)
+    return Edge(leaf=t)
+
+
+def apply_op(name, fwd, args, static_kwargs):
+    """Run ``fwd(*arrays, **static_kwargs)`` eagerly with autograd recording.
+
+    ``args`` may mix Tensors, raw arrays and python scalars; only Tensor args
+    participate in autograd.
+    """
+    vals = []
+    tensor_pos = []
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor):
+            vals.append(a._value)
+            tensor_pos.append(i)
+        else:
+            vals.append(a)
+
+    diff_pos = (
+        [i for i in tensor_pos if _needs_grad(args[i])] if is_grad_enabled() else []
+    )
+
+    if not diff_pos:
+        out = fwd(*vals, **static_kwargs)
+        return _wrap_outputs(out, node=None)
+
+    diff_vals = [vals[i] for i in diff_pos]
+
+    def closed(*dv):
+        vv = list(vals)
+        for p, v in zip(diff_pos, dv):
+            vv[p] = v
+        return fwd(*vv, **static_kwargs)
+
+    primal_out, vjp_fn = jax.vjp(closed, *diff_vals)
+    edges = [_leaf_edge(args[i]) for i in diff_pos]
+    multi = isinstance(primal_out, (tuple, list))
+    outs = list(primal_out) if multi else [primal_out]
+    out_info = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(name, vjp_fn, edges, out_info, multi)
+    return _wrap_outputs(primal_out, node=node)
+
+
+def _wrap_outputs(out, node):
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    wrapped = []
+    for slot, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node = node
+            t._out_slot = slot
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def op(name=None, inplace_of=None):
+    """Declare an op from a raw-jnp forward function.
+
+    The decorated function's positional args may be Tensors (autograd inputs);
+    keyword args are static attributes (baked into the trace, like reference
+    OpMaker attrs).
+    """
+
+    def deco(fwd):
+        opname = name or fwd.__name__
+
+        @functools.wraps(fwd)
+        def wrapper(*args, **kwargs):
+            return apply_op(opname, fwd, args, kwargs)
+
+        wrapper.raw = fwd
+        wrapper.op_name = opname
+        OP_REGISTRY[opname] = wrapper
+        return wrapper
+
+    return deco
+
+
+def ensure_tensor(x, dtype=None, like=None):
+    """Coerce scalars / arrays to Tensor, broadcasting dtype like paddle:
+    python scalar operands adopt the tensor operand's dtype."""
+    if isinstance(x, Tensor):
+        return x
+    if like is not None and isinstance(x, (int, float, bool)):
+        return Tensor(jnp.asarray(x, like.dtype), stop_gradient=True)
+    return Tensor(jnp.asarray(x, dtype), stop_gradient=True)

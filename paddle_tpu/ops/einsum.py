@@ -1,0 +1,19 @@
+"""einsum (reference ``python/paddle/tensor/einsum.py``) — delegates to
+jnp.einsum, which XLA maps onto MXU contractions."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dispatch import op
+
+
+@op("einsum")
+def _einsum_raw(*operands, equation=None):
+    return jnp.einsum(equation, *operands, precision=None)
+
+
+def einsum(equation, *operands):
+    if not isinstance(equation, str):
+        # paddle also allows einsum(op0, op1, ..., equation=...)
+        raise TypeError("first argument must be the equation string")
+    return _einsum_raw(*operands, equation=equation)

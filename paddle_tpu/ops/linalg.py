@@ -1,0 +1,257 @@
+"""Linear algebra (reference ``python/paddle/tensor/linalg.py``; kernels
+``paddle/phi/kernels/*matrix*``, backed by cusolver on GPU — here jax.lax.linalg
+which lowers to XLA's TPU-native decompositions)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import op
+from . import math as _math
+
+matmul = _math.matmul
+dot = _math.dot
+
+
+@op("norm_op")
+def _norm_raw(x, p=2.0, axis=None, keepdim=False):
+    if p == "fro" or p is None:
+        p = 2.0
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(axis, tuple) and len(axis) == 2:
+        return jnp.linalg.norm(x, ord=p if p != 2.0 else None, axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    p = 2.0 if p is None or p == "fro" else p
+    return _norm_raw(x, p=p, axis=axis, keepdim=keepdim)
+
+
+@op("dist")
+def dist(x, y, p=2.0):
+    d = x - y
+    d = d.reshape(-1)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@op("cond_op")
+def _cond_raw(x, p=None):
+    if p is None or p == 2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return s[..., 0] / s[..., -1]
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond_raw(x, p=p)
+
+
+@op("inverse")
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@op("pinv")
+def _pinv_raw(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv_raw(x, rcond=rcond, hermitian=hermitian)
+
+
+@op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op("slogdet")
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(x)
+    return jnp.stack([s, l])
+
+
+@op("cholesky")
+def _cholesky_raw(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky_raw(x, upper=upper)
+
+
+@op("cholesky_solve")
+def _cholesky_solve_raw(x, y, upper=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.cho_solve((y, not upper), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return _cholesky_solve_raw(x, y, upper=upper)
+
+
+@op("solve")
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op("triangular_solve")
+def _triangular_solve_raw(x, y, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return _triangular_solve_raw(x, y, upper=upper, transpose=transpose, unitriangular=unitriangular)
+
+
+@op("lstsq_sol")
+def _lstsq_raw(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol = _lstsq_raw(x, y, rcond=rcond)
+    xv, yv = x._value, y._value
+    res = jnp.sum((xv @ sol._value - yv) ** 2, axis=-2)
+    rank = jnp.linalg.matrix_rank(xv)
+    sv = jnp.linalg.svd(xv, compute_uv=False)
+    return sol, Tensor(res), Tensor(rank), Tensor(sv)
+
+
+@op("qr_op")
+def _qr_raw(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return Tensor(jnp.linalg.qr(x._value, mode="r"))
+    return _qr_raw(x, mode=mode)
+
+
+@op("svd_op")
+def _svd_raw(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+def svd(x, full_matrices=False, name=None):
+    return _svd_raw(x, full_matrices=full_matrices)
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(jnp.asarray(x._value))
+    return Tensor(w), Tensor(v)
+
+
+@op("eigh_op")
+def _eigh_raw(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, symmetrize_input=(UPLO == "L"))
+    return w, v
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh_raw(x, UPLO=UPLO)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.linalg.eigvals(jnp.asarray(x._value)))
+
+
+@op("eigvalsh_op")
+def _eigvalsh_raw(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigvalsh_raw(x, UPLO=UPLO)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x._value, rtol=tol))
+
+
+@op("matrix_power")
+def _matrix_power_raw(x, n=1):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power_raw(x, n=int(n))
+
+
+@op("multi_dot")
+def _multi_dot_raw(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    return _multi_dot_raw(*x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    import jax.scipy.linalg as jsl
+
+    lu_, piv = jsl.lu_factor(x._value)
+    info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+    piv_t = Tensor((piv + 1).astype(jnp.int32))
+    if get_infos:
+        return Tensor(lu_), piv_t, info
+    return Tensor(lu_), piv_t
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(x._value, rowvar=rowvar))
+
+
+@op("cov_op")
+def _cov_raw(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = fweights._value if fweights is not None else None
+    aw = aweights._value if aweights is not None else None
+    return _cov_raw(x, rowvar=rowvar, ddof=ddof, fweights=fw, aweights=aw)
+
+
+@op("histogram_op")
+def _histogram_raw(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=rng)
+    return h
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    return Tensor(_histogram_raw.raw(input._value, bins=bins, min=min, max=max).astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._value if weights is not None else None
+    return Tensor(jnp.bincount(x._value, weights=w, minlength=minlength, length=None))

@@ -1,0 +1,31 @@
+"""Logical & bitwise ops (reference ``python/paddle/tensor/logic.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import ensure_tensor
+
+
+def _logic(fn):
+    def api(x, y=None, out=None, name=None):
+        if y is None:
+            return Tensor(fn(x._value))
+        y = ensure_tensor(y, like=x)
+        return Tensor(fn(x._value, y._value))
+
+    return api
+
+
+logical_and = _logic(jnp.logical_and)
+logical_or = _logic(jnp.logical_or)
+logical_xor = _logic(jnp.logical_xor)
+logical_not = _logic(jnp.logical_not)
+bitwise_and = _logic(jnp.bitwise_and)
+bitwise_or = _logic(jnp.bitwise_or)
+bitwise_xor = _logic(jnp.bitwise_xor)
+bitwise_not = _logic(jnp.bitwise_not)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

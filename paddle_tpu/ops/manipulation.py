@@ -1,0 +1,701 @@
+"""Shape / layout / indexing ops (reference ``python/paddle/tensor/manipulation.py``)."""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor
+from .dispatch import op, ensure_tensor
+
+
+def _ints(shape):
+    if isinstance(shape, Tensor):
+        return [int(v) for v in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+@op("cast")
+def _cast_raw(x, to_dtype=None):
+    return x.astype(to_dtype)
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    if x.dtype == d:
+        return x
+    # int->int casts etc keep stop_gradient; float casts are differentiable
+    return _cast_raw(x, to_dtype=d)
+
+
+@op("reshape")
+def _reshape_raw(x, shape=None):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape_raw(x, shape=tuple(_ints(shape)))
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+@op("transpose")
+def _transpose_raw(x, perm=None):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose_raw(x, perm=tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+@op("moveaxis")
+def _moveaxis_raw(x, source=None, destination=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    as_tup = lambda v: tuple(int(i) for i in np.atleast_1d(v))
+    return _moveaxis_raw(x, source=as_tup(source), destination=as_tup(destination))
+
+
+@op("flatten")
+def _flatten_raw(x, start_axis=0, stop_axis=-1):
+    shape = list(x.shape)
+    nd = len(shape)
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    new_shape = shape[:s] + [-1] + shape[e + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten_raw(x, start_axis=start_axis, stop_axis=stop_axis)
+
+
+@op("squeeze")
+def _squeeze_raw(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None and not isinstance(axis, (list, tuple)):
+        axis = [int(axis)]
+    return _squeeze_raw(x, axis=tuple(axis) if axis is not None else None)
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+@op("unsqueeze")
+def _unsqueeze_raw(x, axis=()):
+    for a in axis:
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = _ints(axis)
+    if not isinstance(axis, (list, tuple)):
+        axis = [int(axis)]
+    return _unsqueeze_raw(x, axis=tuple(int(a) for a in axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+@op("concat")
+def _concat_raw(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat_raw(*x, axis=int(axis))
+
+
+@op("stack")
+def _stack_raw(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack_raw(*x, axis=int(axis))
+
+
+@op("unstack_op")
+def _unstack_raw(x, axis=0, num=None):
+    n = num or x.shape[axis]
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis))
+
+
+def unstack(x, axis=0, num=None):
+    out = _unstack_raw(x, axis=axis, num=num)
+    return list(out)
+
+
+@op("split_op")
+def _split_raw(x, indices=None, axis=0):
+    return tuple(jnp.split(x, indices, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        out = _split_raw(x, indices=num_or_sections, axis=axis)
+    else:
+        secs = _ints(num_or_sections)
+        total = x.shape[axis]
+        known = sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        out = _split_raw(x, indices=idx, axis=axis)
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1 if x.ndim > 1 else 0)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+@op("tile")
+def _tile_raw(x, repeat_times=()):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile_raw(x, repeat_times=tuple(_ints(repeat_times)))
+
+
+@op("expand")
+def _expand_raw(x, shape=()):
+    shape = list(shape)
+    # -1 means keep dim
+    nd_new = len(shape)
+    xshape = list(x.shape)
+    aligned = [1] * (nd_new - len(xshape)) + xshape
+    out_shape = [aligned[i] if shape[i] == -1 else shape[i] for i in range(nd_new)]
+    return jnp.broadcast_to(jnp.reshape(x, aligned), out_shape)
+
+
+def expand(x, shape, name=None):
+    return _expand_raw(x, shape=tuple(_ints(shape)))
+
+
+def expand_as(x, y, name=None):
+    return _expand_raw(x, shape=tuple(y.shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [expand(t, out_shape) for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@op("flip")
+def _flip_raw(x, axis=()):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if not isinstance(axis, (list, tuple)):
+        axis = [axis]
+    return _flip_raw(x, axis=tuple(int(a) for a in axis))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90_raw(x, k=k, axes=tuple(axes))
+
+
+@op("rot90")
+def _rot90_raw(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@op("roll")
+def _roll_raw(x, shifts=None, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = _ints(shifts)
+        shifts = shifts[0] if len(shifts) == 1 else tuple(shifts)
+    elif isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    return _roll_raw(x, shifts=shifts, axis=axis)
+
+
+# ------------------------------------------------------------- indexing -----
+
+
+@op("getitem")
+def _getitem_raw(x, *index_tensors, idx_spec=None):
+    # rebuild index tuple with tensor indices substituted back in
+    it = iter(index_tensors)
+    idx = tuple(next(it) if s is _TENSOR_SLOT else s for s in idx_spec)
+    return x[idx]
+
+
+class _Slot:
+    pass
+
+
+_TENSOR_SLOT = _Slot()
+
+
+def _normalize_index(idx):
+    """Split an index expression into (spec with slots, tensor args)."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec = []
+    tensors = []
+    for it in idx:
+        if isinstance(it, Tensor):
+            v = it._value
+            if v.dtype == jnp.bool_:
+                # boolean mask -> nonzero indices would be dynamic; keep as array
+                tensors.append(v)
+                spec.append(_TENSOR_SLOT)
+            else:
+                tensors.append(v.astype(jnp.int32) if v.dtype == jnp.int64 else v)
+                spec.append(_TENSOR_SLOT)
+        elif isinstance(it, np.ndarray):
+            tensors.append(jnp.asarray(it))
+            spec.append(_TENSOR_SLOT)
+        elif isinstance(it, (list,)) and it and not isinstance(it[0], (slice, type(None))):
+            tensors.append(jnp.asarray(it))
+            spec.append(_TENSOR_SLOT)
+        else:
+            spec.append(it)
+    return tuple(spec), tensors
+
+
+def _getitem(x, idx):
+    spec, tensors = _normalize_index(idx)
+    return _getitem_raw(x, *tensors, idx_spec=spec)
+
+
+@op("setitem")
+def _setitem_raw(x, v, *index_tensors, idx_spec=None):
+    it = iter(index_tensors)
+    idx = tuple(next(it) if s is _TENSOR_SLOT else s for s in idx_spec)
+    if hasattr(v, "astype"):
+        v = v.astype(x.dtype)
+        tgt_shape = tuple(jnp.shape(x[idx]))
+        if tuple(v.shape) != tgt_shape:
+            # paddle allows assigning e.g. shape-(1,) values into scalar slots:
+            # strip leading length-1 dims beyond the target rank, then broadcast
+            while v.ndim > len(tgt_shape) and v.shape[0] == 1:
+                v = v.reshape(v.shape[1:])
+            v = jnp.broadcast_to(v, tgt_shape)
+    return x.at[idx].set(v)
+
+
+def _setitem_(x, idx, value):
+    """__setitem__: functional scatter + in-place rebind (autograd-correct)."""
+    spec, tensors = _normalize_index(idx)
+    value = value if isinstance(value, Tensor) else Tensor(jnp.asarray(value, x.dtype))
+    out = _setitem_raw(x, value, *tensors, idx_spec=spec)
+    x._rebind(out)
+    return x
+
+
+@op("slice_op")
+def _slice_raw(x, axes=(), starts=(), ends=()):
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    return _slice_raw(x, axes=tuple(_ints(axes)), starts=tuple(_ints(starts)), ends=tuple(_ints(ends)))
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(_ints(axes), _ints(starts), _ints(ends), _ints(strides)):
+        idx[a] = builtins_slice(s, e, st)
+    return _getitem(x, tuple(idx))
+
+
+@op("gather")
+def _gather_raw(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    index = ensure_tensor(index)
+    idx = index._value
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return _gather_raw(x, Tensor(idx), axis=int(axis))
+
+
+@op("gather_nd")
+def _gather_nd_raw(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd_raw(x, ensure_tensor(index))
+
+
+@op("take_along_axis")
+def _take_along_axis_raw(x, indices, axis=0):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    idx = indices._value
+    if broadcast:
+        # paddle broadcasts indices against arr except on `axis`
+        tgt = list(arr.shape)
+        tgt[axis] = idx.shape[axis] if idx.ndim == arr.ndim else idx.shape[-1]
+        idx = jnp.broadcast_to(idx, tgt)
+    return _take_along_axis_raw(arr, Tensor(idx), axis=axis)
+
+
+@op("put_along_axis")
+def _put_along_axis_raw(x, indices, values, axis=0, reduce="assign", include_self=True):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    dn = jnp.zeros_like(x) if not include_self else x
+    if reduce in ("add", "sum"):
+        base = x if include_self else jnp.put_along_axis(x, indices, 0, axis=axis, inplace=False)
+        upd = jnp.zeros_like(x)
+        upd = _scatter_add_along(upd, indices, values, axis)
+        return base + upd
+    raise NotImplementedError(f"put_along_axis reduce={reduce}")
+
+
+def _scatter_add_along(zeros, indices, values, axis):
+    # build full index grid and scatter-add
+    idx_full = jnp.indices(indices.shape)
+    idx = list(idx_full)
+    idx[axis] = indices
+    return zeros.at[tuple(idx)].add(values)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
+    values = ensure_tensor(values, like=arr)
+    idx = indices._value
+    v = values._value
+    if broadcast:
+        tgt = list(arr.shape)
+        tgt[axis] = idx.shape[axis] if idx.ndim == arr.ndim else 1
+        idx = jnp.broadcast_to(idx.reshape(idx.shape if idx.ndim == arr.ndim else [-1 if i == axis else 1 for i in range(arr.ndim)]), tgt)
+        v = jnp.broadcast_to(v, tgt) if v.ndim else jnp.full(tgt, v, arr._value.dtype)
+    return _put_along_axis_raw(arr, Tensor(idx), Tensor(v), axis=axis, reduce=reduce, include_self=include_self)
+
+
+@op("scatter")
+def _scatter_raw(x, index, updates, overwrite=True):
+    if index.ndim == 2:
+        index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(updates))
+    return base.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter_raw(x, ensure_tensor(index), updates, overwrite=overwrite)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+@op("scatter_nd_add")
+def _scatter_nd_add_raw(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add_raw(x, ensure_tensor(index), updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from . import creation
+
+    zeros = creation.zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+@op("index_select")
+def _index_select_raw(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select_raw(x, ensure_tensor(index), axis=axis)
+
+
+@op("index_sample")
+def _index_sample_raw(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return _index_sample_raw(x, ensure_tensor(index))
+
+
+@op("index_add")
+def _index_add_raw(x, index, value, axis=0):
+    x_m = jnp.moveaxis(x, axis, 0)
+    v_m = jnp.moveaxis(value, axis, 0)
+    out = x_m.at[index].add(v_m)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add_raw(x, ensure_tensor(index), value, axis=axis)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    spec, tensors = _normalize_index(tuple(indices))
+    value = ensure_tensor(value, like=x)
+    if accumulate:
+        return _index_put_add_raw(x, value, *tensors, idx_spec=spec)
+    return _setitem_raw(x, value, *tensors, idx_spec=spec)
+
+
+@op("index_put_add")
+def _index_put_add_raw(x, v, *index_tensors, idx_spec=None):
+    it = iter(index_tensors)
+    idx = tuple(next(it) if s is _TENSOR_SLOT else s for s in idx_spec)
+    return x.at[idx].add(v)
+
+
+@op("masked_select_sized")
+def _masked_select_raw(x, mask, size=None):
+    # XLA needs static size; paddle's masked_select is dynamic -> we
+    # materialize via nonzero with a static total size (the full numel).
+    flat_x = x.reshape(-1)
+    flat_m = mask.reshape(-1)
+    idx = jnp.nonzero(flat_m, size=size, fill_value=0)[0]
+    return jnp.take(flat_x, idx)
+
+
+def masked_select(x, mask, name=None):
+    mask_b = jnp.broadcast_to(mask._value, x._value.shape)
+    n = int(jnp.sum(mask_b))  # dynamic: forces sync in eager, documented
+    return _masked_select_raw(x, Tensor(mask_b), size=n)
+
+
+@op("masked_fill")
+def _masked_fill_raw(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def masked_fill(x, mask, value, name=None):
+    value = ensure_tensor(value, like=x)
+    return _masked_fill_raw(x, ensure_tensor(mask), value)
+
+
+def masked_fill_(x, mask, value, name=None):
+    return x._rebind(masked_fill(x, mask, value))
+
+
+@op("fill_diagonal")
+def _fill_diagonal_raw(x, value=0.0, offset=0, wrap=False):
+    n = min(x.shape[0], x.shape[1])
+    i = jnp.arange(n - abs(offset))
+    r = i if offset >= 0 else i - offset
+    c = i + offset if offset >= 0 else i
+    return x.at[r, c].set(value)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    return x._rebind(_fill_diagonal_raw(x, value=value, offset=offset, wrap=wrap))
+
+
+@op("where")
+def _where_raw(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x = ensure_tensor(x, like=y if isinstance(y, Tensor) else None)
+    y = ensure_tensor(y, like=x)
+    return _where_raw(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic-shaped: eager-only (forces host sync), like reference nonzero
+    idx = np.nonzero(np.asarray(x._value))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i, jnp.int64)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1), jnp.int64))
+
+
+# ---------------------------------------------------------------- pad -------
+
+
+@op("pad_nd")
+def _pad_raw(x, pad=(), mode="constant", value=0.0):
+    return jnp.pad(x, pad, mode=mode, **({"constant_values": value} if mode == "constant" else {}))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A001
+    pad = _ints(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle full-rank form: [d0_l, d0_r, d1_l, d1_r, ...]
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial form applies to spatial dims per data_format (reference
+        # nn/functional/common.py pad): reversed pairs on trailing dims
+        k = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC/NDHWC/NLC
+            spatial = list(range(1, 1 + k))
+        else:
+            spatial = list(range(nd - k, nd))
+        for j, d in enumerate(spatial):
+            width[d] = (pad[2 * j], pad[2 * j + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return _pad_raw(x, pad=tuple(width), mode=jmode, value=value)
+
+
+# ------------------------------------------------------------- misc ---------
+
+
+@op("repeat_interleave")
+def _repeat_interleave_raw(x, repeats=None, axis=None, index=None, total=None):
+    if index is not None:
+        return jnp.repeat(x, index, axis=axis, total_repeat_length=total)
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        total = int(np.asarray(repeats._value).sum())
+        return _repeat_interleave_raw(x, axis=axis, index=repeats._value, total=total)
+    return _repeat_interleave_raw(x, repeats=int(repeats), axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(x._value)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    a = np.asarray(x._value)
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0 if axis is None else axis], bool)
+    comp = a if axis is None else np.moveaxis(a, axis, 0)
+    keep[1:] = [not np.array_equal(comp[i], comp[i - 1]) for i in range(1, comp.shape[0])]
+    vals = comp[keep]
+    outs = [Tensor(jnp.asarray(vals if axis is None else np.moveaxis(vals, 0, axis)))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv, np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        cnt = np.diff(np.append(idx, comp.shape[0]))
+        outs.append(Tensor(jnp.asarray(cnt, np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+@op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@op("as_complex")
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.shape, jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(x.ndim, jnp.int32))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def increment(x, value=1.0, name=None):
+    return x._rebind(_increment_raw(x, value=value))
+
+
+@op("increment")
+def _increment_raw(x, value=1.0):
+    return x + value
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _ints(shape)
+    offsets = _ints(offsets) if offsets is not None else [0] * x.ndim
+    idx = tuple(builtins_slice(o, o + s if s != -1 else None) for o, s in zip(offsets, shape))
+    return _getitem(x, idx)

@@ -1,0 +1,469 @@
+"""Elementwise & reduction math ops (reference ``python/paddle/tensor/math.py``;
+kernels in ``paddle/phi/kernels/``). Every op is a jnp forward lowered by XLA —
+elementwise chains fuse into surrounding matmuls on the MXU automatically."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor
+from .dispatch import op, ensure_tensor
+
+# ---------------------------------------------------------------- binary ----
+
+
+def _binop(name, fn):
+    raw = op(name)(fn)
+
+    def api(x, y, name=None):
+        x = ensure_tensor(x, like=y if isinstance(y, Tensor) else None)
+        y = ensure_tensor(y, like=x)
+        return raw(x, y)
+
+    api.__name__ = name
+    api.raw = fn
+    return api
+
+
+add = _binop("add", lambda x, y: jnp.add(x, y))
+subtract = _binop("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _binop("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _binop("divide", lambda x, y: jnp.true_divide(x, y))
+floor_divide = _binop("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+remainder = _binop("remainder", lambda x, y: jnp.remainder(x, y))
+mod = remainder
+floor_mod = remainder
+pow_ = _binop("elementwise_pow", lambda x, y: jnp.power(x, y))
+maximum = _binop("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _binop("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _binop("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binop("fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _binop("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binop("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binop("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+nextafter = _binop("nextafter", lambda x, y: jnp.nextafter(x, y))
+copysign = _binop("copysign", lambda x, y: jnp.copysign(x, y))
+heaviside = _binop("heaviside", lambda x, y: jnp.heaviside(x, y))
+gcd = _binop("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _binop("lcm", lambda x, y: jnp.lcm(x, y))
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_(x, y)
+
+
+def divide_no_nan(x, y):
+    return Tensor(jnp.where(y._value == 0, 0, x._value / y._value))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale._value if isinstance(scale, Tensor) else scale
+
+    @op("scale")
+    def _scale(xv):
+        if bias_after_scale:
+            return xv * s + bias
+        return (xv + bias) * s
+
+    out = _scale(x)
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+# --------------------------------------------------------------- unary ------
+
+
+def _unop(name, fn):
+    return op(name)(fn)
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", lambda x: lax.rsqrt(x))
+square = _unop("square", jnp.square)
+abs = _unop("abs", jnp.abs)  # noqa: A001
+sign = _unop("sign", jnp.sign)
+ceil = _unop("ceil", jnp.ceil)
+floor = _unop("floor", jnp.floor)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+reciprocal = _unop("reciprocal", jnp.reciprocal)
+neg = _unop("neg", jnp.negative)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+i0 = _unop("i0", jax.scipy.special.i0)
+i0e = _unop("i0e", jax.scipy.special.i0e)
+i1 = _unop("i1", jax.scipy.special.i1)
+i1e = _unop("i1e", jax.scipy.special.i1e)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+exponent = None  # not in reference API
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(x._value))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(x._value))
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(x._value))
+
+
+@op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@op("clip")
+def _clip_raw(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _clip_raw(x, min=mn, max=mx)
+
+
+@op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@op("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@op("multiplex")
+def _multiplex_raw(*args):
+    index = args[-1]
+    ins = jnp.stack(args[:-1], axis=0)
+    return jnp.take_along_axis(
+        ins, index.reshape(1, -1, *([1] * (ins.ndim - 2))).astype(jnp.int32), axis=0
+    )[0]
+
+
+def multiplex(inputs, index, name=None):
+    return _multiplex_raw(*inputs, index)
+
+
+# ------------------------------------------------------------ reductions ----
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.tolist()
+        return tuple(a) if isinstance(a, list) else int(a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@op("sum")
+def _sum_raw(x, axis=None, keepdim=False, out_dtype=None):
+    return jnp.sum(x, axis=axis, keepdims=keepdim, dtype=out_dtype)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    out_dtype = dtypes.convert_dtype(dtype)
+    if out_dtype is None and dtypes.is_integer(x.dtype) and x.dtype != jnp.int64:
+        out_dtype = jnp.dtype("int64")
+    return _sum_raw(x, axis=_axis(axis), keepdim=keepdim, out_dtype=out_dtype)
+
+
+@op("mean")
+def _mean_raw(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _mean_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@op("max")
+def _max_raw(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _max_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@op("min")
+def _min_raw(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return _min_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@op("amax")
+def _amax_raw(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return _amax_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return _min_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+@op("prod")
+def _prod_raw(x, axis=None, keepdim=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    out = _prod_raw(x, axis=_axis(axis), keepdim=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op("logsumexp")
+def _logsumexp_raw(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp_raw(x, axis=_axis(axis), keepdim=keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.all(x._value, axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return Tensor(jnp.any(x._value, axis=_axis(axis), keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(
+        jnp.count_nonzero(x._value, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64)
+    )
+
+
+@op("cumsum")
+def _cumsum_raw(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum_raw(x, axis=axis if axis is None else int(axis))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op("cumprod")
+def _cumprod_raw(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod_raw(x, dim=dim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op("cummax_val")
+def _cummax_raw(x, axis):
+    return lax.cummax(x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape([-1])
+        axis = 0
+    v = _cummax_raw(x, axis=axis)
+    xv = x._value
+    eq = xv == v._value
+    n = xv.shape[axis]
+    ar = jnp.arange(n).reshape([-1 if i == (axis % xv.ndim) else 1 for i in range(xv.ndim)])
+    idxv = jnp.where(eq, ar, -1)
+    idxv = lax.cummax(idxv, axis=axis)
+    return v, Tensor(idxv.astype(dtypes.convert_dtype(dtype)))
+
+
+@op("cummin_val")
+def _cummin_raw(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cummin(x, axis=axis)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    v = _cummin_raw(x, axis=axis)
+    ax = 0 if axis is None else axis
+    xv = x._value.reshape(-1) if axis is None else x._value
+    eq = xv == v._value
+    n = xv.shape[ax]
+    ar = jnp.arange(n).reshape([-1 if i == (ax % xv.ndim) else 1 for i in range(xv.ndim)])
+    idxv = lax.cummax(jnp.where(eq, ar, -1), axis=ax)
+    return v, Tensor(idxv.astype(dtypes.convert_dtype(dtype)))
+
+
+# ------------------------------------------------------------- matmul -------
+
+
+@op("matmul")
+def _matmul_raw(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        axes = list(range(x.ndim))
+        if len(axes) >= 2:
+            axes[-1], axes[-2] = axes[-2], axes[-1]
+            x = jnp.transpose(x, axes)
+    if transpose_y:
+        axes = list(range(y.ndim))
+        if len(axes) >= 2:
+            axes[-1], axes[-2] = axes[-2], axes[-1]
+            y = jnp.transpose(y, axes)
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul_raw(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+mm = matmul
+
+
+@op("dot")
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@op("mv")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@op("trace")
+def _trace_raw(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace_raw(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op("diagonal")
+def _diagonal_raw(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal_raw(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# ------------------------------------------------------------ logic-ish -----
+
+
+def equal(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value == y._value)
+
+
+def not_equal(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value != y._value)
+
+
+def greater_than(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value > y._value)
+
+
+def greater_equal(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value >= y._value)
+
+
+def less_than(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value < y._value)
+
+
+def less_equal(x, y, name=None):
+    y = ensure_tensor(y, like=x)
+    return Tensor(x._value <= y._value)
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(x._value, y._value))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))

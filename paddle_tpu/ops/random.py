@@ -1,0 +1,112 @@
+"""Random ops (reference ``python/paddle/tensor/random.py``).
+
+All draws split the global Generator key (framework/random.py), so they are
+deterministic under paddle.seed and stay traceable under the jit path (the key
+is part of the functionalized state)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework import random as rnd
+from ..framework.tensor import Tensor
+from .creation import _shape_list, _dt
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape_list(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return Tensor(jax.random.uniform(key, _shape_list(shape), _dt(dtype), minval=mn, maxval=mx))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    return x.set_value(uniform(x.shape, x.dtype, min, max, seed))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape_list(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(rnd.next_key(), sh) * s + m)
+    sh = _shape_list(shape) if shape is not None else []
+    return Tensor(jax.random.normal(rnd.next_key(), sh) * std + mean)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x.set_value(jax.random.normal(rnd.next_key(), tuple(x.shape), x._value.dtype) * std + mean)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.key(seed) if seed else rnd.next_key()
+    return Tensor(jax.random.normal(key, _shape_list(shape), _dt(dtype)) * std + mean)
+
+
+def randint(low=0, high=None, shape=[1], dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(rnd.next_key(), _shape_list(shape), low, high).astype(
+            dtypes.convert_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rnd.next_key(), n).astype(dtypes.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rnd.next_key(), logits, axis=-1, shape=(*v.shape[:-1], num_samples) if v.ndim > 1 else (num_samples,))
+        if v.ndim > 1:
+            out = out.reshape(*v.shape[:-1], num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(rnd.next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    return Tensor(
+        jax.random.bernoulli(rnd.next_key(), x._value).astype(x._value.dtype)
+    )
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(rnd.next_key(), x._value).astype(x._value.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    return x.set_value(jax.random.exponential(rnd.next_key(), tuple(x.shape), x._value.dtype) / lam)
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else count
+    p = prob._value if isinstance(prob, Tensor) else prob
+    return Tensor(jax.random.binomial(rnd.next_key(), c, p).astype(jnp.int64))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    return Tensor(jnp.exp(jax.random.normal(rnd.next_key(), _shape_list(shape or [])) * std + mean))

@@ -1,0 +1,133 @@
+"""Search / sort ops (reference ``python/paddle/tensor/search.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Tensor
+from .dispatch import op, ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = x._value
+    if axis is None:
+        v = v.reshape(-1)
+        axis = 0
+    out = jnp.argmax(v, axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = x._value
+    if axis is None:
+        v = v.reshape(-1)
+        axis = 0
+    out = jnp.argmin(v, axis=axis, keepdims=keepdim)
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    v = x._value
+    idx = jnp.argsort(v, axis=axis, descending=descending, stable=stable)
+    return Tensor(idx.astype(jnp.int64))
+
+
+@op("sort")
+def _sort_raw(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _sort_raw(x, axis=axis, descending=descending)
+
+
+def _lax_topk(x, k, axis):
+    xm = jnp.moveaxis(x, axis, -1)
+    v, i = jax.lax.top_k(xm, k)
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis)
+
+
+@op("topk_op")
+def _topk_raw(x, k=1, axis=-1, largest=True):
+    if largest:
+        v, i = _lax_topk(x, k, axis)
+    else:
+        v, i = _lax_topk(-x, k, axis)
+        v = -v
+    return v, i.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    v, i = _topk_raw(x, k=int(k), axis=int(axis), largest=largest)
+    i.stop_gradient = True
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    v, i = _topk_raw(x, k=int(k), axis=int(axis), largest=False)
+    from . import manipulation as man
+
+    ax = int(axis)
+    vk = man._getitem(v, tuple([slice(None)] * (ax % x.ndim) + [k - 1]))
+    ik = man._getitem(i, tuple([slice(None)] * (ax % x.ndim) + [k - 1]))
+    if keepdim:
+        vk = man.unsqueeze(vk, ax)
+        ik = man.unsqueeze(ik, ax)
+    ik.stop_gradient = True
+    return vk, ik
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    import scipy.stats as _st  # available via scipy dep of jax
+
+    a = np.asarray(x._value)
+    m = _st.mode(a, axis=axis, keepdims=keepdim)
+    idx = np.argmax(a == (m.mode if keepdim else np.expand_dims(m.mode, axis)), axis=axis)
+    if keepdim:
+        idx = np.expand_dims(idx, axis)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(idx, np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    sv = sorted_sequence._value
+    vv = values._value
+    if sv.ndim == 1:
+        out = jnp.searchsorted(sv, vv, side=side)
+    else:
+        out = jnp.stack(
+            [jnp.searchsorted(sv[i], vv[i], side=side) for i in range(sv.shape[0])]
+        )
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+
+    return _w(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    from .manipulation import nonzero as _nz
+
+    return _nz(x, as_tuple)

@@ -1,0 +1,79 @@
+"""Statistics ops (reference ``python/paddle/tensor/stat.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .dispatch import op
+from .math import _axis
+
+
+@op("var_op")
+def _var_raw(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var_raw(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@op("std_op")
+def _std_raw(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std_raw(x, axis=_axis(axis), unbiased=unbiased, keepdim=keepdim)
+
+
+@op("median_op")
+def _median_raw(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _median_raw(x, axis=axis, keepdim=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.nanmedian(x._value, axis=_axis(axis), keepdims=keepdim))
+
+
+@op("quantile_op")
+def _quantile_raw(x, q=0.5, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, q, axis=axis, keepdims=keepdim, method=interpolation)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    if isinstance(q, Tensor):
+        q = q._value
+    elif isinstance(q, (list, tuple)):
+        q = jnp.asarray(q)
+    return _quantile_raw(x, q=q, axis=_axis(axis), keepdim=keepdim, interpolation=interpolation)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    if isinstance(q, Tensor):
+        q = q._value
+    return Tensor(
+        jnp.nanquantile(x._value, q, axis=_axis(axis), keepdims=keepdim, method=interpolation)
+    )
+
+
+@op("nansum")
+def _nansum_raw(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = _nansum_raw(x, axis=_axis(axis), keepdim=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@op("nanmean")
+def _nanmean_raw(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _nanmean_raw(x, axis=_axis(axis), keepdim=keepdim)

@@ -1,0 +1,206 @@
+"""Optimizer base.
+
+Reference ``python/paddle/optimizer/optimizer.py`` (``step:1232``,
+``minimize:1167``, ``_append_optimize_op:559``). TPU-native translation: each
+optimizer's update rule is a pure jnp function over (param, grad, accumulators)
+— executed eagerly per step in dygraph, or traced into the single compiled XLA
+train step by paddle_tpu.jit (where XLA fuses all per-param updates; the
+reference needs hand-fused "fused_adam"/"merged_momentum" ops for this).
+
+Accumulator state lives in ``self._accumulators[name][param_key]`` as raw jnp
+arrays, exposed as a pytree for jit-functionalization via ``_state_pytree``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Parameter, Tensor
+from ..autograd import no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                self._param_groups = parameters
+                flat = []
+                for g in parameters:
+                    flat.extend(g["params"])
+                parameters = flat
+            else:
+                self._param_groups = None
+        else:
+            self._param_groups = None
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._accumulators = {}
+        self._name = name or type(self).__name__
+        self._step_count = 0
+
+    # -- learning rate -------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    def _lr_array(self):
+        return jnp.asarray(self.get_lr(), jnp.float32)
+
+    # -- accumulators --------------------------------------------------------
+    @staticmethod
+    def _pkey(p):
+        return p.name or f"@{id(p)}"
+
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None, shape=None):
+        store = self._accumulators.setdefault(name, {})
+        key = self._pkey(param)
+        if key not in store:
+            store[key] = jnp.full(
+                shape if shape is not None else tuple(param.shape),
+                fill_value,
+                dtype or (param._value.dtype if dtypes.is_floating(param.dtype) else jnp.float32),
+            )
+        return store[key]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][self._pkey(param)]
+
+    def _set_accumulator(self, name, param, value):
+        self._accumulators[name][self._pkey(param)] = value
+
+    # -- main API ------------------------------------------------------------
+    def _collect_params_grads(self):
+        pgs = []
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            pgs.append((p, p.grad))
+        return pgs
+
+    def _apply_decay(self, p, g):
+        """L2Decay-style regularization folded into the gradient
+        (reference regularizer.py L2Decay appended before optimize op)."""
+        wd = self._weight_decay
+        if wd is None:
+            return g
+        from ..regularizer import L2Decay, L1Decay
+
+        if isinstance(wd, L2Decay):
+            coeff = wd._coeff
+            return g + coeff * p._value
+        if isinstance(wd, L1Decay):
+            return g + wd._coeff * jnp.sign(p._value)
+        if isinstance(wd, float) and not getattr(self, "_decoupled_wd", False):
+            return g + wd * p._value
+        return g
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        pgs = self._collect_params_grads()
+        if self._grad_clip is not None:
+            pgs = self._grad_clip(pgs)
+        lr = self._lr_array()
+        for p, g in pgs:
+            if g is None:
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            if p.regularizer is not None:
+                gv = gv + p.regularizer._coeff * p._value
+            else:
+                gv = self._apply_decay(p, gv)
+            param_lr = p.optimize_attr.get("learning_rate", 1.0)
+            new_val = self._update_param(p, gv, lr * param_lr)
+            p._value = new_val.astype(p._value.dtype)
+
+    def _update_param(self, p, grad, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """dygraph minimize = backward + step (reference optimizer.py:1167)."""
+        loss.backward()
+        self.step()
+        return None, None
+
+    def backward(self, loss, startup_program=None, parameters=None, no_grad_set=None, callbacks=None):
+        loss.backward()
+        return self._collect_params_grads()
+
+    def apply_gradients(self, params_grads):
+        lr = self._lr_array()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            gv = g._value if isinstance(g, Tensor) else g
+            p._value = self._update_param(p, gv, lr).astype(p._value.dtype)
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for key, v in store.items():
+                sd[f"{key}_{name}"] = Tensor(v)
+        sd["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        if "@step" in state_dict:
+            self._step_count = int(state_dict["@step"])
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for name, store in self._accumulators.items():
+            for key in store:
+                k = f"{key}_{name}"
+                if k in state_dict:
+                    v = state_dict[k]
+                    store[key] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        # also allow loading accumulators created lazily later
+        self._pending_state = {
+            k: (v._value if isinstance(v, Tensor) else v)
+            for k, v in state_dict.items()
+            if k not in ("@step", "LR_Scheduler")
+        }
+
+    # -- jit functionalization hooks ----------------------------------------
+    def _state_pytree(self):
+        return {
+            "accumulators": self._accumulators,
+            "step": jnp.asarray(self._step_count, jnp.int32),
+        }
+
+    def _load_state_pytree(self, tree):
+        self._accumulators = tree["accumulators"]
+        try:
+            self._step_count = int(tree["step"])
+        except TypeError:  # traced value
+            self._step_count = tree["step"]

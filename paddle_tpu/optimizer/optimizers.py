@@ -1,0 +1,194 @@
+"""Concrete optimizers (reference ``python/paddle/optimizer/{sgd,momentum,adam,
+adamw,rmsprop,adagrad,adamax,adadelta,lamb}.py``; kernels
+``paddle/phi/kernels/gpu/adam_kernel.cu`` etc.).
+
+Like the reference, Adam-family keeps beta-power accumulators as *arrays* so
+the update is step-index-free and fully traceable (reference beta1_pow_acc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = [
+    "SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad", "Adadelta",
+    "RMSProp", "Lamb",
+]
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, grad, lr):
+        return p._value - lr * grad
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, grad, lr):
+        v = self._add_accumulator("velocity", p)
+        v_new = self._momentum * v + grad
+        self._set_accumulator("velocity", p, v_new)
+        if self._use_nesterov:
+            return p._value - lr * (grad + self._momentum * v_new)
+        return p._value - lr * v_new
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_param(self, p, grad, lr):
+        m = self._add_accumulator("moment1", p)
+        v = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=())
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=1.0, shape=())
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        m_new = self._beta1 * m + (1 - self._beta1) * grad
+        v_new = self._beta2 * v + (1 - self._beta2) * jnp.square(grad)
+        m_hat = m_new / (1 - b1p)
+        v_hat = v_new / (1 - b2p)
+        self._set_accumulator("moment1", p, m_new)
+        self._set_accumulator("moment2", p, v_new)
+        self._set_accumulator("beta1_pow", p, b1p)
+        self._set_accumulator("beta2_pow", p, b2p)
+        return p._value - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip, name=name)
+        self._wd_coeff = weight_decay if isinstance(weight_decay, float) else 0.01
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decoupled_wd = True
+
+    def _update_param(self, p, grad, lr):
+        decay = True
+        if self._apply_decay_param_fun is not None:
+            decay = self._apply_decay_param_fun(p.name)
+        base = p._value
+        if decay:
+            base = base * (1.0 - lr * self._wd_coeff)
+        old = p._value
+        try:
+            p._value = base
+            return super()._update_param(p, grad, lr)
+        finally:
+            p._value = old
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, grad, lr):
+        m = self._add_accumulator("moment", p)
+        u = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=())
+        b1p = b1p * self._beta1
+        m_new = self._beta1 * m + (1 - self._beta1) * grad
+        u_new = jnp.maximum(self._beta2 * u, jnp.abs(grad))
+        self._set_accumulator("moment", p, m_new)
+        self._set_accumulator("inf_norm", p, u_new)
+        self._set_accumulator("beta1_pow", p, b1p)
+        return p._value - lr / (1 - b1p) * m_new / (u_new + self._epsilon)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, grad, lr):
+        acc = self._add_accumulator("moment", p, fill_value=self._init_acc)
+        acc_new = acc + jnp.square(grad)
+        self._set_accumulator("moment", p, acc_new)
+        return p._value - lr * grad / (jnp.sqrt(acc_new) + self._epsilon)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, grad, lr):
+        avg_sq = self._add_accumulator("avg_squared_grad", p)
+        avg_up = self._add_accumulator("avg_squared_update", p)
+        avg_sq_new = self._rho * avg_sq + (1 - self._rho) * jnp.square(grad)
+        update = -jnp.sqrt((avg_up + self._epsilon) / (avg_sq_new + self._epsilon)) * grad
+        avg_up_new = self._rho * avg_up + (1 - self._rho) * jnp.square(update)
+        self._set_accumulator("avg_squared_grad", p, avg_sq_new)
+        self._set_accumulator("avg_squared_update", p, avg_up_new)
+        return p._value + lr * update
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update_param(self, p, grad, lr):
+        ms = self._add_accumulator("mean_square", p)
+        mom = self._add_accumulator("momentum", p)
+        ms_new = self._rho * ms + (1 - self._rho) * jnp.square(grad)
+        self._set_accumulator("mean_square", p, ms_new)
+        if self._centered:
+            mg = self._add_accumulator("mean_grad", p)
+            mg_new = self._rho * mg + (1 - self._rho) * grad
+            self._set_accumulator("mean_grad", p, mg_new)
+            denom = jnp.sqrt(ms_new - jnp.square(mg_new) + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms_new + self._epsilon)
+        mom_new = self._momentum * mom + lr * grad / denom
+        self._set_accumulator("momentum", p, mom_new)
+        return p._value - mom_new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, grad, lr):
+        m = self._add_accumulator("moment1", p)
+        v = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=())
+        b2p = self._add_accumulator("beta2_pow", p, fill_value=1.0, shape=())
+        b1p = b1p * self._beta1
+        b2p = b2p * self._beta2
+        m_new = self._beta1 * m + (1 - self._beta1) * grad
+        v_new = self._beta2 * v + (1 - self._beta2) * jnp.square(grad)
+        self._set_accumulator("moment1", p, m_new)
+        self._set_accumulator("moment2", p, v_new)
+        self._set_accumulator("beta1_pow", p, b1p)
+        self._set_accumulator("beta2_pow", p, b2p)
+        m_hat = m_new / (1 - b1p)
+        v_hat = v_new / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        update = r + wd * p._value
+        w_norm = jnp.linalg.norm(p._value)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p._value - lr * trust * update

@@ -1,0 +1,22 @@
+"""Regularizers (reference ``python/paddle/fluid/regularizer.py``;
+applied by folding into grads before the optimizer update)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

@@ -1,0 +1,17 @@
+"""Test harness config.
+
+Per SURVEY.md §4: tests run on a virtual 8-device CPU mesh
+(``xla_force_host_platform_device_count``) so every collective/parallelism
+strategy is exercised without TPU hardware; numeric checks pin matmul
+precision to HIGHEST (TPU default bf16 matmuls would break finite-difference
+gradient comparisons)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_default_matmul_precision", "highest")

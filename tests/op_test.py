@@ -1,0 +1,56 @@
+"""OpTest-style numeric gradient checker.
+
+Clone of the reference harness idea (``python/paddle/fluid/tests/unittests/
+op_test.py:309`` — ``check_grad:1851`` compares analytic grads against
+central-difference numeric grads via ``get_numeric_gradient:126``)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def numeric_grad(fn, inputs, idx, out_grad=None, delta=1e-3):
+    """Central-difference gradient of sum(fn(*inputs) * out_grad) w.r.t inputs[idx]."""
+    # note: jax->numpy arrays may be F-ordered; force C-contiguous copies so
+    # in-place perturbation below actually lands in the evaluated array
+    base = [np.ascontiguousarray(t.numpy(), dtype=np.float64) for t in inputs]
+
+    def eval_at(vals):
+        ts = [paddle.to_tensor(v.astype(np.float32)) for v in vals]
+        out = fn(*ts)
+        o = out.numpy().astype(np.float64)
+        w = out_grad if out_grad is not None else np.ones_like(o)
+        return float((o * w).sum())
+
+    x = base[idx]
+    g = np.zeros_like(x)
+    for i in range(x.size):
+        orig = x.flat[i]
+        x.flat[i] = orig + delta
+        fp = eval_at(base)
+        x.flat[i] = orig - delta
+        fm = eval_at(base)
+        x.flat[i] = orig
+        g.flat[i] = (fp - fm) / (2 * delta)
+    return g
+
+
+def check_grad(fn, input_arrays, rtol=1e-2, atol=1e-3, delta=1e-3, out_grad=None):
+    """Compare analytic backward() grads to finite differences for all inputs."""
+    tensors = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False) for a in input_arrays]
+    out = fn(*tensors)
+    if out_grad is not None:
+        out.backward(paddle.to_tensor(out_grad.astype(np.float32)))
+    else:
+        seed = paddle.ones(out.shape, out.dtype)
+        out.backward(seed)
+    for i, t in enumerate(tensors):
+        ng = numeric_grad(fn, tensors, i, out_grad=out_grad, delta=delta)
+        ag = t.grad.numpy().astype(np.float64)
+        np.testing.assert_allclose(
+            ag, ng, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for input {i} of {getattr(fn, '__name__', fn)}",
+        )
+    return out

@@ -1,0 +1,163 @@
+"""Autograd engine tests (semantics mirror reference eager autograd tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_input_fanout():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+    assert y.stop_gradient
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def f(t):
+        return t * 2
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3), stop_gradient=False)
+    a, b, c = paddle.split(x, 3, axis=1)
+    (a.sum() + (2 * b).sum()).backward()  # c unused -> zero grad path
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 0], [1, 2, 0]])
+
+
+def test_register_hook_leaf():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: seen.append(g.numpy()))
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    h.remove()
+
+
+def test_register_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_hook_on_intermediate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.register_hook(lambda g: g * 10)
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [60.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [6.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_paddle_grad_intermediate_input():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    z = y * y
+    (gy,) = paddle.grad(z, y)
+    np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+def test_paddle_grad_unused_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(ValueError):
+        paddle.grad(y, z, retain_graph=True)
+    (g,) = paddle.grad(y, [z], allow_unused=True)
+    assert g is None
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 5.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 10.0])
+
+
+def test_setitem_autograd():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    v = paddle.to_tensor([10.0], stop_gradient=False)
+    y = x * 1
+    y[1] = v
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+    np.testing.assert_allclose(v.grad.numpy(), [1.0])
+
+
+def test_getitem_autograd():
+    x = paddle.to_tensor(np.arange(9, dtype="float32").reshape(3, 3), stop_gradient=False)
+    x[1:, paddle.to_tensor([0, 2])].sum().backward()
+    expect = np.zeros((3, 3))
+    expect[1:, [0, 2]] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expect)

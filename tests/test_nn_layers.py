@@ -1,0 +1,103 @@
+"""Layer system tests (reference test_imperative_* suites)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_layer_registration_and_traversal():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    assert len(net.sublayers()) == 3
+    out = net(paddle.randn([2, 4]))
+    assert out.shape == [2, 2]
+
+
+def test_train_eval_mode_propagates():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([10, 4])
+    y1, y2 = net(x), net(x)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())
+    net.train()
+    assert net[1].training
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(3, 5), nn.BatchNorm1D(5))
+    sd = net.state_dict()
+    assert set(sd) == {"0.weight", "0.bias", "1.weight", "1.bias", "1._mean", "1._variance"}
+    net2 = nn.Sequential(nn.Linear(3, 5), nn.BatchNorm1D(5))
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2[0].weight.numpy(), net[0].weight.numpy())
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm1D(4, momentum=0.5)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 4).astype("float32") * 3 + 1)
+    bn.train()
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    m0 = bn._mean.numpy().copy()
+    bn(x)
+    np.testing.assert_allclose(bn._mean.numpy(), m0)  # eval must not update
+
+
+def test_forward_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    lin(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    lin(paddle.ones([1, 2]))
+    assert calls == []
+
+
+def test_containers():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+    pl = nn.ParameterList([paddle.create_parameter([2, 2], "float32")])
+    assert len(list(pl.parameters())) == 1
+    sd = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in sd
+
+
+def test_layer_to_dtype():
+    net = nn.Linear(2, 2)
+    net.to(dtype="bfloat16")
+    assert str(net.weight.dtype) == "bfloat16"
+
+
+def test_parameter_trainable_flag():
+    lin = nn.Linear(2, 2)
+    lin.weight.trainable = False
+    out = lin(paddle.ones([1, 2])).sum()
+    out.backward()
+    assert lin.weight.grad is None
+    assert lin.bias.grad is not None
+
+
+def test_clear_gradients():
+    lin = nn.Linear(2, 2)
+    lin(paddle.ones([1, 2])).sum().backward()
+    lin.clear_gradients()
+    assert lin.weight.grad is None

@@ -1,0 +1,99 @@
+"""Per-op finite-difference gradient checks (reference OpTest.check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+rng = np.random.RandomState(42)
+
+
+@pytest.mark.parametrize(
+    "fn,shapes",
+    [
+        (lambda x, y: paddle.add(x, y), [(3, 4), (3, 4)]),
+        (lambda x, y: paddle.subtract(x, y), [(3, 4), (4,)]),
+        (lambda x, y: paddle.multiply(x, y), [(3, 4), (3, 4)]),
+        (lambda x, y: paddle.divide(x, y + 2.0), [(3, 4), (3, 4)]),
+        (lambda x, y: paddle.matmul(x, y), [(3, 4), (4, 5)]),
+        (lambda x, y: paddle.matmul(x, y, transpose_y=True), [(3, 4), (5, 4)]),
+        (lambda x: paddle.exp(x), [(3, 3)]),
+        (lambda x: paddle.tanh(x), [(3, 3)]),
+        (lambda x: paddle.sum(x, axis=1), [(3, 4)]),
+        (lambda x: paddle.mean(x), [(3, 4)]),
+        (lambda x: paddle.reshape(x, [2, 6]), [(3, 4)]),
+        (lambda x: paddle.transpose(x, [1, 0]), [(3, 4)]),
+        (lambda x: paddle.concat([x, x], axis=0), [(2, 3)]),
+        (lambda x: F.relu(x), [(4, 4)]),
+        (lambda x: F.sigmoid(x), [(3, 3)]),
+        (lambda x: F.softmax(x, axis=-1), [(3, 5)]),
+        (lambda x: F.gelu(x), [(3, 3)]),
+        (lambda x: paddle.squeeze(paddle.unsqueeze(x, 1), 1), [(3, 4)]),
+    ],
+)
+def test_grad_matches_numeric(fn, shapes):
+    arrays = [rng.randn(*s).astype(np.float32) for s in shapes]
+    check_grad(fn, arrays)
+
+
+def test_log_softmax_grad():
+    arrays = [rng.randn(3, 5).astype(np.float32)]
+    check_grad(lambda x: F.log_softmax(x, axis=-1), arrays, rtol=6e-2, atol=3e-3)
+
+
+def test_layer_norm_grad():
+    arrays = [rng.randn(4, 8).astype(np.float32)]
+    check_grad(lambda x: F.layer_norm(x, 8), arrays, rtol=2e-2, atol=2e-3)
+
+
+def test_conv2d_grad():
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    check_grad(lambda x_, w_: F.conv2d(x_, w_, padding=1), [x, w], rtol=2e-2, atol=2e-3)
+
+
+def test_softmax_ce_grad():
+    logits = rng.randn(4, 7).astype(np.float32)
+    labels = np.array([0, 3, 6, 2])
+
+    def fn(lg):
+        return F.cross_entropy(lg, paddle.to_tensor(labels), reduction="mean")
+
+    check_grad(fn, [logits])
+
+
+def test_embedding_grad():
+    w = rng.randn(10, 4).astype(np.float32)
+    ids = paddle.to_tensor(np.array([1, 3, 3, 7]))
+
+    def fn(w_):
+        return F.embedding(ids, w_)
+
+    check_grad(fn, [w])
+
+
+def test_pool_grads():
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    check_grad(lambda t: F.avg_pool2d(t, 2), [x])
+    check_grad(lambda t: F.max_pool2d(t, 2), [x])
+
+
+def test_bmm_and_einsum():
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 4, 5).astype(np.float32)
+    check_grad(lambda x, y: paddle.bmm(x, y), [a, b])
+    check_grad(lambda x, y: paddle.einsum("bij,bjk->bik", x, y), [a, b])
+
+
+def test_forward_values_against_numpy():
+    x = rng.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(paddle.exp(t).numpy(), np.exp(x), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sum(t, axis=0).numpy(), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.clip(t, -0.5, 0.5).numpy(), np.clip(x, -0.5, 0.5), rtol=1e-6
+    )
+    np.testing.assert_allclose(paddle.t(t).numpy(), x.T)
+    v, i = paddle.topk(t, 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1)[:, ::-1][:, :2], rtol=1e-5)
