@@ -68,6 +68,14 @@ from . import autograd  # noqa: F401
 # Subsystems are appended here as they land (build order in SURVEY.md §7).
 from . import nn  # noqa: F401
 from .nn.layer.container import LayerList, ParameterList, Sequential  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from .framework.io import load, save  # noqa: F401
 
 # paddle.disable_static/enable_static compat: we are always "dygraph" unless
 # tracing; these are no-ops kept for API parity.

@@ -1,0 +1,16 @@
+"""Automatic mixed precision.
+
+Reference: ``python/paddle/amp/auto_cast.py:21`` (O1/O2),
+``amp/grad_scaler.py:26 GradScaler``; C++ tracer hooks
+``paddle/fluid/imperative/amp_auto_cast.h`` with per-op allow/block lists.
+
+TPU-native translation (SURVEY.md §7): the mixed dtype is **bfloat16**, which
+needs NO loss scaling (same exponent range as fp32) — GradScaler is kept
+API-compatible but becomes a passthrough for bf16 (it still implements real
+scaling + inf/nan skip logic, used if dtype='float16').
+
+O1 = op-level autocast via a dispatch-layer hook: matmul/conv-family ops run
+in bf16, reductions/norms/softmax stay fp32. O2 = whole-model bf16 (decorate).
+"""
+from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

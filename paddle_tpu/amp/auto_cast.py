@@ -1,0 +1,97 @@
+"""Autocast (reference ``python/paddle/amp/auto_cast.py``; op lists
+``paddle/fluid/imperative/amp_auto_cast.cc AmpOperators``)."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..ops import dispatch
+
+# Reference O1 lists (imperative/amp_auto_cast.cc): matmul/conv family compute
+# in low precision; numerically-sensitive ops stay fp32.
+white_list = {
+    "matmul", "conv_nd", "conv_transpose_nd", "linear", "bmm", "mv", "einsum",
+    "addmm", "dot", "inner", "outer", "sdpa", "bilinear_op",
+}
+black_list = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "pow", "elementwise_pow",
+    "square", "rsqrt", "softmax_op", "log_softmax_op", "softmax_ce", "weighted_nll",
+    "soft_nll", "nll_loss_op", "bce_op", "bce_logits_op", "kl_div_op",
+    "layer_norm_op", "batch_norm_train", "batch_norm_infer", "group_norm_op",
+    "instance_norm_op", "mean", "sum", "cumsum", "norm_op", "dist", "cosine_similarity_op",
+    "sigmoid_focal_op", "ctc_op", "rms_norm",
+}
+
+
+def _amp_fwd_wrapper(name, fwd, lowp, wl, bl):
+    def wrapped(*vals, **kw):
+        if name in wl:
+            vals = tuple(
+                v.astype(lowp)
+                if hasattr(v, "dtype") and v.dtype == jnp.float32
+                else v
+                for v in vals
+            )
+        elif name in bl:
+            vals = tuple(
+                v.astype(jnp.float32)
+                if hasattr(v, "dtype") and v.dtype == lowp
+                else v
+                for v in vals
+            )
+        return fwd(*vals, **kw)
+
+    return wrapped
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast (O1: per-op lists; O2: cast-everything-but-blacklist)."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(
+            "level should be O0, O1 or O2, but received %r" % (level,)
+        )
+    if not enable or level == "O0":
+        yield
+        return
+    lowp = dtypes.convert_dtype(dtype)
+    prev_hook = dispatch.AMP_HOOK
+    wl = set(white_list) | set(custom_white_list or ())
+    bl = (set(black_list) | set(custom_black_list or ())) - set(custom_white_list or ())
+
+    def hook(name, fwd):
+        if level == "O2":
+            # O2: inputs are already low precision (decorate()); only the
+            # effective blacklist is upcast back to fp32.
+            if name in bl:
+                return _amp_fwd_wrapper(name, fwd, lowp, frozenset(), bl)
+            return fwd
+        if name in wl or name in bl:
+            return _amp_fwd_wrapper(name, fwd, lowp, wl, bl)
+        return fwd
+
+    dispatch.AMP_HOOK = hook
+    try:
+        yield
+    finally:
+        dispatch.AMP_HOOK = prev_hook
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the low-precision dtype
+    (reference amp/auto_cast.py decorate:81). On TPU bf16 master weights are
+    generally unnecessary; master_weight=True keeps an fp32 copy inside the
+    optimizer accumulators (they are fp32 already)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers

@@ -1,0 +1,26 @@
+"""paddle_tpu.distributed — TPU-native distributed API.
+
+Reference surface: ``python/paddle/distributed`` (collective.py, parallel.py,
+fleet/). TPU redesign: the process model is one controller per host driving
+all local chips (jax), so "rank"/"world size" map to
+``jax.process_index()``/device mesh coordinates rather than one process per
+GPU. Collectives lower to XLA HLO collectives over a ``jax.sharding.Mesh``
+instead of NCCL rings (SURVEY.md §5 "Distributed communication backend").
+"""
+from __future__ import annotations
+
+import os
+
+from .parallel import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+
+__all__ = [
+    "ParallelEnv",
+    "get_rank",
+    "get_world_size",
+    "init_parallel_env",
+]

@@ -1,0 +1,36 @@
+"""Batch collation (reference ``python/paddle/fluid/dataloader/collate.py``).
+Collates to device Tensors; numbers->stacked arrays, dicts/sequences recursed."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["default_collate_fn", "default_convert_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch, axis=0))
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch], axis=0))
+    if isinstance(sample, numbers.Number):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn(list(fields)) for fields in zip(*batch)]
+    raise TypeError(f"cannot collate batch of {type(sample)}")
+
+
+def default_convert_fn(batch):
+    if isinstance(batch, (Tensor, np.ndarray)):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return [default_convert_fn(b) for b in batch]
+    return batch

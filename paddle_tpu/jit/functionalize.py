@@ -1,0 +1,216 @@
+"""Dygraph→XLA functionalization: the "executor" of this framework.
+
+Reference analogue: ``paddle.jit.to_static`` (AST transpile to ProgramDesc,
+``dygraph_to_static/program_translator.py:991``) executed by
+InterpreterCore (``framework/new_executor/interpretercore.h:38``).
+
+TPU-native redesign: there is no IR of our own and no interpreter. A python
+step function (forward+backward+optimizer.step, written in eager dygraph
+style) is *traced by jax.jit* — the tape's vjp closures are jax-traceable, so
+the entire step lowers to ONE fused XLA program. Mutable framework state
+(Layer params/buffers, optimizer accumulators, the RNG key) is threaded as an
+explicit donated pytree: functional on the inside, mutable on the outside.
+
+This replaces, in one mechanism: ProgramDesc construction, the op-by-op
+executors, stream-aware scheduling, per-op GC, gradient fusion (Reducer
+buckets), and fused-optimizer ops — XLA does the scheduling and fusion.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["functionalize", "CompiledStep", "to_static", "not_to_static"]
+
+
+def _layer_state(layer: Layer):
+    state = {"params": {}, "buffers": {}}
+    for name, p in layer.named_parameters():
+        state["params"][name] = p._value
+    for name, b in layer.named_buffers():
+        if b is not None:
+            state["buffers"][name] = b._value
+    return state
+
+
+def _layer_refs(layer: Layer):
+    refs = {"params": {}, "buffers": {}}
+    for name, p in layer.named_parameters():
+        refs["params"][name] = p
+    for name, b in layer.named_buffers():
+        if b is not None:
+            refs["buffers"][name] = b
+    return refs
+
+
+class _StateSpec:
+    """Collects and swaps mutable state for a set of Layers/Optimizers."""
+
+    def __init__(self, stateful):
+        self.layers = [s for s in stateful if isinstance(s, Layer)]
+        self.optimizers = [s for s in stateful if isinstance(s, Optimizer)]
+        self._refs = [_layer_refs(l) for l in self.layers]
+
+    def snapshot(self):
+        return {
+            "layers": [_layer_state(l) for l in self.layers],
+            "optimizers": [o._state_pytree() for o in self.optimizers],
+            "rng": rnd.default_generator.get_state(),
+        }
+
+    def install(self, tree):
+        for refs, st in zip(self._refs, tree["layers"]):
+            for name, p in refs["params"].items():
+                p._value = st["params"][name]
+            for name, b in refs["buffers"].items():
+                b._value = st["buffers"][name]
+        for o, st in zip(self.optimizers, tree["optimizers"]):
+            o._load_state_pytree(st)
+        rnd.default_generator.set_state(tree["rng"])
+
+    def clear_grads(self):
+        for refs in self._refs:
+            for p in refs["params"].values():
+                p._grad = None
+                p._grad_node = None
+                p._out_slot = 0
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def _wrap(x, stop_gradient=True):
+    if isinstance(x, (jax.Array,)) or isinstance(x, jax.core.Tracer):
+        return Tensor(x, stop_gradient=stop_gradient)
+    return x
+
+
+class CompiledStep:
+    """A cached compiled XLA step (≙ the reference's compiled-program cache in
+    ``fluid/executor.py`` + InterpreterCore instruction list)."""
+
+    def __init__(self, fn, stateful=(), donate_state=True, static_argnames=None):
+        self.fn = fn
+        self.spec = _StateSpec(stateful)
+        self._pure = self._build_pure()
+        donate = (0,) if donate_state else ()
+        self._jitted = jax.jit(
+            self._pure, donate_argnums=donate, static_argnames=static_argnames
+        )
+
+    def _build_pure(self):
+        spec = self.spec
+        fn = self.fn
+
+        def pure(state, args_tree):
+            prev = spec.snapshot()
+            spec.install(state)
+            try:
+                args, kwargs = args_tree
+                t_args = jax.tree_util.tree_map(_wrap, args)
+                t_kwargs = jax.tree_util.tree_map(_wrap, kwargs)
+                out = fn(*t_args, **t_kwargs)
+                out_arrays = jax.tree_util.tree_map(_unwrap, out)
+                new_state = spec.snapshot()
+            finally:
+                spec.clear_grads()
+                spec.install(prev)
+            return out_arrays, new_state
+
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        state = self.spec.snapshot()
+        arr_args = jax.tree_util.tree_map(_unwrap, args)
+        arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
+        out_arrays, new_state = self._jitted(state, (arr_args, arr_kwargs))
+        self.spec.install(new_state)
+        self.spec.clear_grads()
+        return jax.tree_util.tree_map(lambda a: _wrap(a), out_arrays)
+
+    def lower(self, *args, **kwargs):
+        state = self.spec.snapshot()
+        arr_args = jax.tree_util.tree_map(_unwrap, args)
+        arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
+        return self._jitted.lower(state, (arr_args, arr_kwargs))
+
+
+def functionalize(fn=None, *, stateful=(), donate_state=True):
+    """Decorator: compile a dygraph-style step function into one XLA program.
+
+        @paddle_tpu.jit.functionalize(stateful=[model, opt])
+        def train_step(x, y):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+    """
+
+    def deco(f):
+        step = CompiledStep(f, stateful=stateful, donate_state=donate_state)
+        functools.update_wrapper(step, f, updated=())
+        return step
+
+    return deco(fn) if fn is not None else deco
+
+
+class StaticFunction:
+    """`@to_static` on a Layer's forward / plain function (inference path):
+    no in-place state writes expected; buffers treated read-only."""
+
+    def __init__(self, fn, layer=None):
+        self.fn = fn
+        self.layer = layer
+        self._compiled = None
+
+    def _ensure(self):
+        if self._compiled is None:
+            stateful = [self.layer] if self.layer is not None else []
+            self._compiled = CompiledStep(self.fn, stateful=stateful, donate_state=False)
+        return self._compiled
+
+    def __call__(self, *args, **kwargs):
+        return self._ensure()(*args, **kwargs)
+
+    @property
+    def code(self):
+        import inspect
+
+        return inspect.getsource(self.fn)
+
+    def concrete_program(self, *args, **kwargs):
+        return self._ensure().lower(*args, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """paddle.jit.to_static — here: jax.jit tracing instead of AST transpile.
+
+    Python control flow on traced values raises a clear jax error (the
+    reference rewrites if/for via AST transformers; the TPU-native contract is
+    lax.cond/scan via paddle_tpu.static.nn.cond/while_loop)."""
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k), layer=layer)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn)
+
+    return deco(function) if function is not None else deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

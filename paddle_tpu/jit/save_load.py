@@ -1,0 +1,113 @@
+"""paddle.jit.save / paddle.jit.load.
+
+Reference: serialized ProgramDesc + params (``paddle/fluid/jit/serializer.cc``,
+``python/paddle/fluid/dygraph/jit.py``). TPU-native: the portable artifact is
+a *StableHLO export* (jax.export) of the traced forward plus a pickled
+state_dict — loadable without the original python class (TranslatedLayer)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.io import load as _pload
+from ..framework.io import save as _psave
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["save", "load", "TranslatedLayer", "InputSpec"]
+
+
+class InputSpec:
+    """reference ``paddle/static/input.py InputSpec``."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def _to_example(self, sym_prefix="d"):
+        from ..framework.dtype import convert_dtype
+
+        dt = convert_dtype(self.dtype)
+        if any(s is None or s < 0 for s in self.shape):
+            # dynamic dims export as jax.export symbolic dimensions, so the
+            # loaded program accepts any size (e.g. variable batch)
+            dims = []
+            for i, s in enumerate(self.shape):
+                if s is None or s < 0:
+                    dims.append(jax.export.symbolic_shape(f"{sym_prefix}{i}")[0])
+                else:
+                    dims.append(int(s))
+            return jax.ShapeDtypeStruct(tuple(dims), dt)
+        return jnp.zeros([int(s) for s in self.shape], dt)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer.forward as StableHLO + weights at `path`(.pdmodel/.pdiparams)."""
+    layer.eval()
+    if input_spec is None:
+        raise ValueError("paddle.jit.save requires input_spec on TPU build")
+    examples = [
+        s._to_example(sym_prefix=f"s{i}_") if isinstance(s, InputSpec) else jnp.asarray(np.asarray(s.numpy() if isinstance(s, Tensor) else s))
+        for i, s in enumerate(input_spec)
+    ]
+    params = {k: v._value for k, v in layer.state_dict().items()}
+
+    def pure_forward(params, *inputs):
+        # install weights functionally into a stateless call
+        sd = layer.state_dict()
+        old = {k: t._value for k, t in sd.items()}
+        for k, t in sd.items():
+            t._value = params[k]
+        try:
+            out = layer(*[Tensor(i) for i in inputs])
+        finally:
+            for k, t in sd.items():
+                t._value = old[k]
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out
+        )
+
+    jitted = jax.jit(pure_forward)
+    exported = jax.export.export(jitted)(params, *examples)
+    blob = exported.serialize()
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    _psave({k: Tensor(v) for k, v in params.items()}, path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"n_inputs": len(examples)}, f)
+
+
+class TranslatedLayer(Layer):
+    """A loaded StableHLO program behaving like a Layer
+    (reference ``fluid/dygraph/io.py TranslatedLayer``)."""
+
+    def __init__(self, exported, params):
+        super().__init__()
+        self._exported = exported
+        self._params_tree = {k: (v._value if isinstance(v, Tensor) else jnp.asarray(v)) for k, v in params.items()}
+
+    def forward(self, *inputs):
+        arrays = [i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs]
+        out = self._exported.call(self._params_tree, *arrays)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def state_dict(self, *a, **k):
+        return {k2: Tensor(v) for k2, v in self._params_tree.items()}
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    params = _pload(path + ".pdiparams")
+    return TranslatedLayer(exported, params)

@@ -27,6 +27,10 @@ from ..framework.tensor import Tensor
 
 OP_REGISTRY = {}
 
+# set by paddle_tpu.amp.auto_cast: callable (op_name, fwd) -> fwd implementing
+# O1 per-op dtype policy (reference imperative/amp_auto_cast.h AutoCastGuard)
+AMP_HOOK = None
+
 
 def _needs_grad(t: Tensor) -> bool:
     return (not t.stop_gradient) and dtypes.is_differentiable(t.dtype)
@@ -44,6 +48,8 @@ def apply_op(name, fwd, args, static_kwargs):
     ``args`` may mix Tensors, raw arrays and python scalars; only Tensor args
     participate in autograd.
     """
+    if AMP_HOOK is not None:
+        fwd = AMP_HOOK(name, fwd)
     vals = []
     tensor_pos = []
     for i, a in enumerate(args):

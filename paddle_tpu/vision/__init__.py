@@ -1,0 +1,3 @@
+"""paddle.vision equivalent."""
+from . import datasets, models, transforms  # noqa: F401
+from .models import LeNet, ResNet  # noqa: F401

@@ -1,0 +1,175 @@
+"""Vision datasets (reference ``python/paddle/vision/datasets/``).
+
+Zero-egress environment: datasets load from local files when present
+(``~/.cache/paddle_tpu/datasets`` or explicit paths); otherwise MNIST and
+Cifar fall back to a deterministic synthetic sample set so training loops and
+tests run offline."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder", "ImageFolder"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+def _synthetic_images(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n).astype(np.int64)
+    imgs = (rng.rand(n, *shape) * 255).astype(np.uint8)
+    # make classes separable: add a class-dependent bright square
+    side = shape[0] // 4
+    for i, lab in enumerate(labels):
+        r = (lab * 2) % (shape[0] - side)
+        imgs[i, r : r + side, r : r + side] = 255 - (lab * 9) % 128
+    return imgs, labels
+
+
+class MNIST(Dataset):
+    """reference ``python/paddle/vision/datasets/mnist.py`` (idx-ubyte files)."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        images, labels = self._load(image_path, label_path, mode)
+        self.images = images
+        self.labels = labels
+
+    def _load(self, image_path, label_path, mode):
+        name = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(_CACHE, "mnist", f"{name}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(_CACHE, "mnist", f"{name}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+            return images, labels
+        n = 6000 if mode == "train" else 1000
+        return _synthetic_images(n, (28, 28), 10, seed=0 if mode == "train" else 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None, :, :] / 255.0
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    def _load(self, image_path, label_path, mode):
+        name = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(_CACHE, "fashion-mnist", f"{name}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(_CACHE, "fashion-mnist", f"{name}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            return super()._load(image_path, label_path, mode)
+        n = 6000 if mode == "train" else 1000
+        return _synthetic_images(n, (28, 28), 10, seed=2 if mode == "train" else 3)
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        path = data_file or os.path.join(_CACHE, "cifar10", f"{mode}.npz")
+        if os.path.exists(path):
+            d = np.load(path)
+            imgs, labels = d["images"], d["labels"]
+        else:
+            n = 5000 if mode == "train" else 1000
+            imgs, labels = _synthetic_images(n, (32, 32, 3), self.NUM_CLASSES, seed=4 if mode == "train" else 5)
+        self.images, self.labels = imgs, labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32) / 255.0, (2, 0, 1))
+        return img, np.asarray(label, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class DatasetFolder(Dataset):
+    """reference vision/datasets/folder.py — directory-per-class layout."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy", ".png", ".jpg", ".jpeg", ".bmp")
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        raise RuntimeError(
+            f"no image decoder available for {path}; provide loader= or use .npy"
+        )
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or (".npy", ".png", ".jpg", ".jpeg", ".bmp")
+        self.samples = [
+            os.path.join(root, f)
+            for f in sorted(os.listdir(root))
+            if f.lower().endswith(extensions)
+        ]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)

@@ -1,0 +1,266 @@
+"""Vision transforms (reference ``python/paddle/vision/transforms/``). Numpy
+(HWC uint8/float) based; run in dataloader workers on host, off the TPU."""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "RandomCrop", "CenterCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "ContrastTransform",
+    "SaturationTransform", "ColorJitter", "Grayscale", "RandomRotation",
+]
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] uint8 -> CHW float32 [0,1] numpy (kept host-side)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        if a.dtype == np.uint8:
+            a = a.astype(np.float32) / 255.0
+        else:
+            a = a.astype(np.float32)
+        if self.data_format == "CHW":
+            a = np.transpose(a, (2, 0, 1))
+        return a
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            n = a.shape[0]
+            return (a - self.mean[:n, None, None]) / self.std[:n, None, None]
+        n = a.shape[-1]
+        return (a - self.mean[:n]) / self.std[:n]
+
+
+def _resize_np(a, size):
+    """nearest-neighbor resize for HWC numpy (host-side, no PIL dependency)."""
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    ri = (np.arange(nh) * h / nh).astype(int).clip(0, h - 1)
+    ci = (np.arange(nw) * w / nw).astype(int).clip(0, w - 1)
+    return a[ri][:, ci]
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return _resize_np(np.asarray(img), self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0, padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) else [self.padding] * 4
+            a = np.pad(a, ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (a.ndim - 2))
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return a[i : i + th, j : j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return a[i : i + th, j : j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[:, :, None]
+        return np.transpose(a, self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = [padding] * 4 if isinstance(padding, int) else list(padding)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        width = ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (a.ndim - 2)
+        return np.pad(a, width, constant_values=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3), interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            ar = random.uniform(*self.ratio)
+            tw = int(round((target_area * ar) ** 0.5))
+            th = int(round((target_area / ar) ** 0.5))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                return _resize_np(a[i : i + th, j : j + tw], self.size)
+        return _resize_np(a, self.size)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(a * f, 0, 255 if a.max() > 1 else 1.0)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = a.mean()
+        return np.clip((a - mean) * f + mean, 0, 255 if a.max() > 1 else 1.0)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        if a.ndim < 3 or a.shape[-1] == 1:
+            return a
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = a.mean(axis=-1, keepdims=True)
+        return np.clip(gray + (a - gray) * f, 0, 255 if a.max() > 1 else 1.0)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+
+    def _apply_image(self, img):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            img = t(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        a = np.asarray(img, np.float32)
+        if a.ndim == 3 and a.shape[-1] >= 3:
+            g = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+        else:
+            g = a.reshape(a.shape[:2])
+        g = g[:, :, None]
+        return np.repeat(g, self.n, axis=-1) if self.n > 1 else g
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) else degrees
+
+    def _apply_image(self, img):
+        import scipy.ndimage as ndi
+
+        a = np.asarray(img)
+        angle = random.uniform(*self.degrees)
+        return ndi.rotate(a, angle, reshape=False, order=1, mode="nearest")

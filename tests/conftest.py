@@ -15,3 +15,9 @@ if "host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_default_matmul_precision", "highest")
+
+# Persistent compilation cache: the eager path compiles one executable per
+# (op, shape) — cache them across tests and across pytest runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pt_cache")
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -1,0 +1,135 @@
+"""End-to-end dygraph training (BASELINE config 1: MNIST LeNet).
+Mirrors reference book tests (``tests/book/test_recognize_digits.py`` idea):
+loss must decrease and accuracy must beat chance on a learnable problem."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_mnist_training_loss_decreases():
+    paddle.seed(0)
+    train_ds = MNIST(mode="train")
+    loader = DataLoader(train_ds, batch_size=64, shuffle=True, drop_last=True)
+    model = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    losses = []
+    it = iter(loader)
+    for step in range(30):
+        img, label = next(it)
+        logits = model(img)
+        loss = loss_fn(logits, label)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    first = np.mean(losses[:5])
+    last = np.mean(losses[-5:])
+    assert last < first, f"loss did not decrease: {first} -> {last}"
+
+    # eval accuracy on a training slice should beat chance by a wide margin
+    model.eval()
+    img, label = next(iter(DataLoader(train_ds, batch_size=256)))
+    with paddle.no_grad():
+        acc = paddle.metric.accuracy(model(img), label)
+    assert float(acc) > 0.3, f"accuracy too low: {float(acc)}"
+
+
+def test_sgd_momentum_training():
+    paddle.seed(1)
+    x = paddle.randn([128, 10])
+    w_true = paddle.randn([10, 1])
+    y = paddle.matmul(x, w_true) + 0.01 * paddle.randn([128, 1])
+
+    lin = nn.Linear(10, 1)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=lin.parameters())
+    for _ in range(50):
+        loss = F.mse_loss(lin(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < 0.05
+
+
+def test_lr_scheduler_integration():
+    lin = nn.Linear(2, 2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=lin.parameters())
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.01) < 1e-9
+
+
+def test_grad_clip_global_norm():
+    lin = nn.Linear(4, 4)
+    clip = nn.ClipGradByGlobalNorm(clip_norm=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=lin.parameters(), grad_clip=clip)
+    (lin(paddle.randn([8, 4])).sum() * 100).backward()
+    pgs = [(p, p.grad) for p in lin.parameters()]
+    clipped = clip(pgs)
+    total = np.sqrt(sum(float((g.numpy() ** 2).sum()) for _, g in clipped))
+    assert total <= 0.11
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = LeNet()
+    opt = paddle.optimizer.Adam(parameters=model.parameters())
+    model(paddle.randn([1, 1, 28, 28])).sum().backward()
+    opt.step()
+    paddle.save(model.state_dict(), str(tmp_path / "model.pdparams"))
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+
+    model2 = LeNet()
+    model2.set_state_dict(paddle.load(str(tmp_path / "model.pdparams")))
+    for (n1, p1), (n2, p2) in zip(model.named_parameters(), model2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), err_msg=n1)
+
+    opt2 = paddle.optimizer.Adam(parameters=model2.parameters())
+    opt2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+
+
+def test_amp_autocast_o1():
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        c = paddle.matmul(a, b)
+        assert str(c.dtype) == "bfloat16"
+        s = F.softmax(c)  # blacklist -> fp32
+        assert str(s.dtype) == "float32"
+    c2 = paddle.matmul(a, b)
+    assert str(c2.dtype) == "float32"
+
+
+def test_grad_scaler_dynamics():
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0, incr_every_n_steps=1)
+    loss = lin(paddle.ones([1, 2])).sum()
+    scaled = scaler.scale(loss)
+    assert abs(float(scaled) - float(loss) * 128.0) < 1e-3
+    scaled.backward()
+    w_before = lin.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.allclose(lin.weight.numpy(), w_before)
+    assert scaler.get_init_loss_scaling() == 256.0  # incr after 1 good step
+
+
+def test_dataloader_workers_and_samplers():
+    ds = MNIST(mode="test")
+    loader = DataLoader(ds, batch_size=32, num_workers=2, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    img, label = batches[0]
+    assert img.shape == [32, 1, 28, 28]
+    # parity with single-process
+    loader0 = DataLoader(ds, batch_size=32, num_workers=0, shuffle=False)
+    img0, label0 = next(iter(loader0))
+    np.testing.assert_allclose(img.numpy(), img0.numpy())
