@@ -9,18 +9,76 @@ instead of NCCL rings (SURVEY.md §5 "Distributed communication backend").
 """
 from __future__ import annotations
 
-import os
-
+from . import mesh  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_group,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream_sync,
+    wait,
+)
+from .data_parallel import DataParallel, shard_batch  # noqa: F401
 from .parallel import (  # noqa: F401
     ParallelEnv,
     get_rank,
     get_world_size,
     init_parallel_env,
 )
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from . import sharding  # noqa: F401
 
 __all__ = [
+    "ReduceOp",
+    "Group",
+    "new_group",
+    "get_group",
+    "is_initialized",
+    "all_reduce",
+    "all_gather",
+    "all_gather_object",
+    "all_to_all",
+    "alltoall",
+    "alltoall_single",
+    "broadcast",
+    "reduce",
+    "reduce_scatter",
+    "scatter",
+    "send",
+    "recv",
+    "isend",
+    "irecv",
+    "barrier",
+    "wait",
+    "stream_sync",
+    "DataParallel",
+    "shard_batch",
     "ParallelEnv",
     "get_rank",
     "get_world_size",
     "init_parallel_env",
+    "CommunicateTopology",
+    "HybridCommunicateGroup",
+    "fleet",
+    "meta_parallel",
+    "sharding",
+    "mesh",
 ]

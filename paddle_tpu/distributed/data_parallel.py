@@ -1,0 +1,101 @@
+"""DataParallel.
+
+Reference: ``python/paddle/fluid/dygraph/parallel.py:419 DataParallel`` +
+the C++ ``Reducer`` (``imperative/reducer.h:129``) doing size-bucketed fused
+allreduce overlapped with backward.
+
+TPU-native redesign (SURVEY.md §7): no Reducer, no buckets, no comm_buffer
+tuning. Parameters are *replicated* over the ``dp`` mesh axis and the batch
+is *sharded* over it; every eager op then executes SPMD under GSPMD, and the
+gradient cross-replica sum is inserted by XLA inside the same program as the
+backward math — fused and overlapped by the compiler, which is exactly what
+the Reducer hand-builds for CUDA. ``comm_buffer_size_MB``/
+``last_comm_buffer_size_MB`` are accepted and ignored.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import mesh as mesh_mod
+from .collective import Group, _default_group
+
+__all__ = ["DataParallel", "shard_batch"]
+
+
+def shard_batch(x, group=None, axis=0):
+    """Place a host batch onto the mesh sharded along the dp axis (the
+    data-feed boundary: one device_put instead of per-rank feeds)."""
+    g = group or _default_group()
+    spec = [None] * (x.ndim if hasattr(x, "ndim") else len(x.shape))
+    spec[axis] = g.axis_name
+    sh = NamedSharding(g.mesh, P(*spec))
+    v = x._value if isinstance(x, Tensor) else x
+    out = jax.device_put(v, sh)
+    if isinstance(x, Tensor):
+        t = Tensor(out, stop_gradient=x.stop_gradient)
+        t._grad_node = x._grad_node
+        t._out_slot = x._out_slot
+        return t
+    return Tensor(out)
+
+
+class DataParallel(Layer):
+    def __init__(
+        self,
+        layers,
+        strategy=None,
+        comm_buffer_size=25,
+        last_comm_buffer_size=1,
+        find_unused_parameters=False,
+        group=None,
+    ):
+        super().__init__()
+        self._layers = layers
+        self._group = group or _default_group()
+        self.find_unused_parameters = find_unused_parameters
+        # replicate parameters & buffers across the mesh (reference: initial
+        # param broadcast from rank 0, parallel.py sync_params_buffers)
+        repl = NamedSharding(self._group.mesh, P())
+        for p in layers.parameters(include_sublayers=True):
+            p._value = jax.device_put(p._value, repl)
+        for _, buf in layers.named_buffers():
+            if isinstance(buf, Tensor):
+                buf._value = jax.device_put(buf._value, repl)
+
+    def forward(self, *inputs, **kwargs):
+        sharded = [
+            shard_batch(i, self._group) if isinstance(i, Tensor) else i
+            for i in inputs
+        ]
+        return self._layers(*sharded, **kwargs)
+
+    # reference API surface --------------------------------------------------
+    def scale_loss(self, loss):
+        """Reference divides loss by nranks before backward; with a batch
+        sharded over the mesh the mean over the global batch is already the
+        right scale — identity."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Grad allreduce happens inside the XLA program (GSPMD); no-op."""
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers"], name)

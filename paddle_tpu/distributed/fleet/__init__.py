@@ -1,0 +1,40 @@
+"""Fleet — the unified distributed-training facade.
+
+Reference: ``python/paddle/distributed/fleet/base/fleet_base.py:144 Fleet``
+(init:211, distributed_optimizer:890, distributed_model:947) driven by a
+``DistributedStrategy`` protobuf. TPU-native: ``init`` builds the hybrid
+Mesh (HybridCommunicateGroup), ``distributed_model`` wraps the layer for the
+resolved parallel mode, ``distributed_optimizer`` adds hybrid-aware clip /
+grad handling. No RoleMaker server/worker split (no parameter server on the
+TPU path; SURVEY.md §7 descopes PS) — role info comes from jax process
+metadata.
+"""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.fleet_base import Fleet, fleet
+
+# module-level singleton API (reference exposes `paddle.distributed.fleet.*`)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+worker_endpoints = fleet.worker_endpoints
+barrier_worker = fleet.barrier_worker
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+__all__ = [
+    "DistributedStrategy",
+    "Fleet",
+    "fleet",
+    "init",
+    "distributed_model",
+    "distributed_optimizer",
+    "worker_num",
+    "worker_index",
+    "is_first_worker",
+    "barrier_worker",
+    "get_hybrid_communicate_group",
+]

@@ -1,0 +1,95 @@
+"""DistributedStrategy.
+
+Reference: ``fleet/base/distributed_strategy.py:110`` wrapping
+``framework/distributed_strategy.proto`` (~80 knobs driving the
+meta-optimizer chain). TPU build keeps the user-facing knobs that still
+mean something under XLA (amp, recompute, hybrid degrees, sharding,
+gradient_merge) and accepts-but-ignores CUDA-machinery tuning
+(fuse_grad_size_in_MB, nccl_comm_num, …) so reference configs load
+unchanged.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class _ConfigDict(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # meaningful on TPU
+        self.amp = False
+        self.amp_configs = _ConfigDict(
+            init_loss_scaling=2.0**15,
+            custom_white_list=[],
+            custom_black_list=[],
+            use_pure_fp16=False,
+            use_fp16_guard=False,
+            dtype="bfloat16",
+            level="O1",
+        )
+        self.recompute = False
+        self.recompute_configs = _ConfigDict(checkpoints=[], enable_offload=False)
+        self.hybrid_configs = _ConfigDict(
+            dp_degree=1,
+            mp_degree=1,
+            pp_degree=1,
+            sharding_degree=1,
+            sep_degree=1,
+            mp_configs=_ConfigDict(sync_param=False, sync_grad=False, sync_moment=False),
+            # empty by default: pipeline_configs holds the defaults; entries
+            # set here override it (PipelineParallel reads both)
+            pp_configs=_ConfigDict(),
+        )
+        self.sharding = False
+        self.sharding_configs = _ConfigDict(
+            stage=1, degree=8, offload=False, segment_broadcast_MB=32.0
+        )
+        self.pipeline = False
+        self.pipeline_configs = _ConfigDict(
+            micro_batch_size=1, accumulate_steps=1, schedule_mode="1F1B"
+        )
+        self.gradient_merge = False
+        self.gradient_merge_configs = _ConfigDict(k_steps=1, avg=True)
+        self.gradient_scale_configs = _ConfigDict(scale_strategy="avg")
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = _ConfigDict(
+            tensor_parallel_degree=1, tensor_init_seed=-1
+        )
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+        # accepted for config compatibility; no-ops under XLA
+        self.without_graph_optimization = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.localsgd = False
+        self.dgc = False
+        self.lars = False
+        self.lamb = False
+        self.asp = False
+        self.fp16_allreduce = False
+        self.a_sync = False
+        self.auto = False
+        self.semi_auto = False
+        self.cudnn_exhaustive_search = False
+        self.conv_workspace_size_limit = 512
+        self.cudnn_batchnorm_spatial_persistent = False
+
+    def __repr__(self):
+        on = [
+            k
+            for k, v in self.__dict__.items()
+            if v is True and not k.endswith("_configs")
+        ]
+        return f"DistributedStrategy(enabled={on}, hybrid={dict(self.hybrid_configs)})"

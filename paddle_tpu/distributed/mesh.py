@@ -1,0 +1,53 @@
+"""Global device-mesh registry.
+
+TPU-native replacement for the reference's comm-context registries
+(``platform/collective_helper.h:71 NCCLCommContext`` ring_id→comm map and
+``distributed/collective/ProcessGroup.h:53``): instead of NCCL rings we keep
+one (or more) ``jax.sharding.Mesh`` whose named axes are the communication
+"rings". A collective "group" is (mesh, axis_name); XLA lowers the
+collectives onto ICI/DCN links for the axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_GLOBAL_MESH: Mesh | None = None
+
+
+def set_mesh(mesh: Mesh):
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _GLOBAL_MESH
+
+
+def build_mesh(shape_dict) -> Mesh:
+    """Build a mesh from ``{axis_name: size}`` over all visible devices.
+
+    Axis order follows insertion order; sizes must multiply to <= device
+    count (trailing devices unused, like reference ring construction using a
+    subset of ranks).
+    """
+    names = list(shape_dict.keys())
+    sizes = [int(shape_dict[n]) for n in names]
+    n = int(np.prod(sizes))
+    devs = jax.devices()
+    if n > len(devs):
+        raise ValueError(
+            f"mesh {shape_dict} needs {n} devices, only {len(devs)} visible"
+        )
+    arr = np.array(devs[:n]).reshape(sizes)
+    return Mesh(arr, axis_names=names)
+
+
+def default_mesh(axis_name="dp") -> Mesh:
+    """All visible devices on one data axis (classic DP world)."""
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh({axis_name: len(jax.devices())})
+    return _GLOBAL_MESH

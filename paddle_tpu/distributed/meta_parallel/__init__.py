@@ -1,0 +1,33 @@
+"""meta_parallel — dygraph parallel wrappers & parallel layers.
+
+Reference: ``python/paddle/distributed/fleet/meta_parallel/`` (mp_layers,
+tensor_parallel, pipeline_parallel, pp_layers, sharding/). See each module
+for the TPU-native mapping.
+"""
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+
+__all__ = [
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "ParallelCrossEntropy",
+    "PipelineLayer",
+    "LayerDesc",
+    "SharedLayerDesc",
+    "PipelineParallel",
+    "TensorParallel",
+    "HybridParallelOptimizer",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+    "model_parallel_random_seed",
+]

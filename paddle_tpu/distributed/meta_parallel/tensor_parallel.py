@@ -1,0 +1,65 @@
+"""TensorParallel model wrapper.
+
+Reference: ``fleet/meta_parallel/tensor_parallel.py`` — broadcasts input
+data across the mp group and syncs params at init. TPU-native: mp-sharded
+params already carry their sharding (mp_layers); non-distributed params and
+inputs are replicated over the mesh, dp-axis inputs sharded on batch.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..data_parallel import shard_batch
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        repl = NamedSharding(hcg.mesh, P())
+        for p in layers.parameters(include_sublayers=True):
+            if not getattr(p, "is_distributed", False):
+                p._value = jax.device_put(p._value, repl)
+        for _, buf in layers.named_buffers():
+            if isinstance(buf, Tensor):
+                buf._value = jax.device_put(buf._value, repl)
+
+    def forward(self, *inputs, **kwargs):
+        dp = self._hcg.get_data_parallel_group()
+        outs = []
+        for i in inputs:
+            if isinstance(i, Tensor) and dp.nranks > 1:
+                outs.append(shard_batch(i, dp))
+            elif isinstance(i, Tensor):
+                i._value = jax.device_put(
+                    i._value, NamedSharding(self._hcg.mesh, P())
+                )
+                outs.append(i)
+            else:
+                outs.append(i)
+        return self._layers(*outs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers"], name)

@@ -1,0 +1,3 @@
+from .group_sharded import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]

@@ -1,0 +1,168 @@
+"""Group-sharded (ZeRO) data parallelism.
+
+Reference: ``fleet/meta_parallel/sharding/group_sharded_stage2.py`` /
+``group_sharded_stage3.py`` / ``group_sharded_optimizer_stage2.py`` and the
+public API ``sharding/group_sharded.py group_sharded_parallel`` — thousands
+of lines of rank-slice bookkeeping, buffer fusion (``group_sharded_storage``),
+broadcast-on-use and grad-scatter hooks.
+
+TPU-native redesign: ZeRO is a *placement policy*, not a runtime. Sharding a
+param / grad / optimizer-state array over the ``sharding`` mesh axis IS the
+stage partition; XLA's SPMD partitioner inserts the all-gather-on-use
+(stage3 forward), reduce-scatter (stage2 grads) and sharded update (stage1)
+that the reference hand-codes. The three levels map to which arrays carry
+the sharding:
+
+    stage1 'os'     — optimizer accumulators sharded
+    stage2 'os_g'   — + gradients resharded on accumulation
+    stage3 'p_g_os' — + parameters sharded (gathered on use by XLA)
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..collective import Group
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model", "ShardedLayer"]
+
+
+def _axis_sharding(group, ndim, shape):
+    """Shard dim0 over the group axis when divisible, else replicate (the
+    reference pads/flattens into rank buffers; XLA needs divisibility)."""
+    if ndim >= 1 and shape[0] % group.nranks == 0 and shape[0] > 0:
+        return NamedSharding(group.mesh, P(group.axis_name))
+    return NamedSharding(group.mesh, P())
+
+
+def _shard_value(v, group):
+    return jax.device_put(v, _axis_sharding(group, v.ndim, v.shape))
+
+
+def _sharding_group(group):
+    if group is not None:
+        return group
+    from ..fleet.base.fleet_base import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_sharding_parallel_group()
+    from ..collective import _default_group
+
+    return _default_group()
+
+
+class ShardedLayer(Layer):
+    """Stage-3 wrapper: parameters live sharded; XLA gathers on use."""
+
+    def __init__(self, layer, group):
+        super().__init__()
+        self._layers = layer
+        self._group = group
+        for p in layer.parameters(include_sublayers=True):
+            p._value = _shard_value(p._value, group)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def get_all_parameters(self):
+        """reference stage3 API: gather full params (here: reshard to
+        replicated)."""
+        repl = NamedSharding(self._group.mesh, P())
+        for p in self._layers.parameters(include_sublayers=True):
+            p._value = jax.device_put(p._value, repl)
+        return self._layers.parameters()
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._sub_layers["_layers"], name)
+
+
+class _ShardedOptimizer:
+    """Stage-1/2 optimizer wrapper: accumulators (and stage2: grads) are
+    sharded over the group axis (reference GroupShardedOptimizerStage2)."""
+
+    def __init__(self, optimizer, group, shard_grads):
+        self._inner_opt = optimizer
+        self._group = group
+        self._shard_grads = shard_grads
+
+    def step(self):
+        g = self._group
+        if self._shard_grads:
+            for p in self._inner_opt._parameter_list or []:
+                if p.grad is not None:
+                    p.grad._value = _shard_value(p.grad._value, g)
+        self._inner_opt.step()
+        # shard the accumulators the step just created/updated (raw jnp
+        # arrays in Optimizer._accumulators[name][param_key])
+        for store in getattr(self._inner_opt, "_accumulators", {}).values():
+            if not isinstance(store, dict):
+                continue
+            for key, acc in store.items():
+                if hasattr(acc, "ndim") and acc.ndim >= 1:
+                    store[key] = _shard_value(acc, g)
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+
+def group_sharded_parallel(
+    model,
+    optimizer=None,
+    level="os_g",
+    scaler=None,
+    group=None,
+    offload=False,
+    sync_buffers=False,
+    buffer_max_size=2**23,
+    segment_size=2**20,
+    sync_comm=False,
+):
+    """reference ``sharding/group_sharded.py group_sharded_parallel``."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level should be os, os_g or p_g_os, got %r" % level)
+    g = _sharding_group(group)
+    if level == "p_g_os":
+        model = ShardedLayer(model, g)
+    else:
+        # params replicated over the sharding axis (classic DP postcondition)
+        repl = NamedSharding(g.mesh, P())
+        for p in model.parameters(include_sublayers=True):
+            p._value = jax.device_put(p._value, repl)
+    if optimizer is not None:
+        optimizer = _ShardedOptimizer(optimizer, g, shard_grads=level != "os")
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference ``group_sharded.py save_group_sharded_model``: gather then
+    save full state."""
+    from ...framework.io import save
+
+    m = model
+    if isinstance(m, ShardedLayer):
+        m.get_all_parameters()
+        m = m._layers
+    save(m.state_dict(), output + ".pdparams" if not output.endswith(".pdparams") else output)
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

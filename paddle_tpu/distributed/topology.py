@@ -1,0 +1,204 @@
+"""Hybrid-parallel topology.
+
+Reference: ``python/paddle/distributed/fleet/base/topology.py`` —
+``CommunicateTopology:52`` (rank ↔ [data, pipe, sharding, model, sep]
+coordinates) and ``HybridCommunicateGroup:134`` (per-axis comm groups).
+
+TPU-native: the coordinate system IS a ``jax.sharding.Mesh`` with named axes
+``(dp, pp, sharding, mp, sep)`` (size-1 axes elided). Per-axis "comm groups"
+are just the axis names; collectives lower to XLA collectives on that axis.
+ICI-friendly ordering: the innermost (fastest-varying) mesh axis maps to the
+most bandwidth-hungry parallelism (mp), mirroring how the reference orders
+NCCL rings [data, pipe, sharding, model].
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from . import mesh as mesh_mod
+from .collective import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    """Pure coordinate math over named axes (reference ``topology.py:52``)."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"), dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in self._dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        ax = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self.coordinate) if c[ax] == index]
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks varying only on ``axis_name`` (the comm rings)."""
+        ax = self._parallel_names.index(axis_name)
+        others = [
+            range(d) for i, d in enumerate(self._dims) if i != ax
+        ]
+        rings = []
+        for fixed in itertools.product(*others):
+            ring = []
+            for v in range(self._dims[ax]):
+                coord = list(fixed)
+                coord.insert(ax, v)
+                ring.append(self._coord2rank[tuple(coord)])
+            rings.append(ring)
+        return rings
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = list(self.get_coord(global_rank))
+        for k, v in kwargs.items():
+            coord[self._parallel_names.index(k)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+# mesh axis names used throughout the TPU build (reference names in comments)
+AXIS_DP = "dp"        # "data"
+AXIS_PP = "pp"        # "pipe"
+AXIS_SHARD = "sharding"
+AXIS_MP = "mp"        # "model" (tensor parallel)
+AXIS_SEP = "sep"      # sequence/context parallel — green-field (SURVEY §5)
+
+
+class HybridCommunicateGroup:
+    """reference ``topology.py:134``. Builds the global Mesh for a 4-D (±sep)
+    hybrid strategy and hands out per-axis Groups.
+
+    Mesh axis order is (pp, dp, sharding, sep, mp): pp outermost (lowest
+    bandwidth need — can cross DCN), mp innermost (highest bandwidth —
+    stays on ICI neighbors). Size-1 axes are kept in the mesh (harmless to
+    XLA) so the axis names are always valid.
+    """
+
+    def __init__(self, topology: CommunicateTopology | None = None, *,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1):
+        if topology is not None:
+            names = topology.get_hybrid_group_names()
+            get = lambda n: topology.get_dim(n) if n in names else 1
+            dp_degree = get("data")
+            pp_degree = get("pipe")
+            sharding_degree = get("sharding")
+            mp_degree = get("model")
+            sep_degree = get("sep")
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+
+        n = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        devs = jax.devices()
+        if n > len(devs):
+            raise ValueError(
+                f"hybrid strategy needs {n} devices "
+                f"(dp{dp_degree}×pp{pp_degree}×sharding{sharding_degree}"
+                f"×sep{sep_degree}×mp{mp_degree}), have {len(devs)}"
+            )
+        arr = np.array(devs[:n]).reshape(
+            pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree
+        )
+        self.mesh = Mesh(arr, axis_names=(AXIS_PP, AXIS_DP, AXIS_SHARD, AXIS_SEP, AXIS_MP))
+        mesh_mod.set_mesh(self.mesh)
+
+        self._dp_group = Group(self.mesh, AXIS_DP)
+        self._mp_group = Group(self.mesh, AXIS_MP)
+        self._pp_group = Group(self.mesh, AXIS_PP)
+        self._sharding_group = Group(self.mesh, AXIS_SHARD)
+        self._sep_group = Group(self.mesh, AXIS_SEP)
+        self.global_rank = 0
+
+    # -- degrees (reference topology.py:141-144) ----------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- parallel mode resolution (reference topology.py:198-205) -----------
+    def _check_vaild_topo(self):
+        return True
+
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1:
+            return "data_parallel"
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return "sharding_parallel"
+        if self._pp_degree > 1:
+            return "pipeline_parallel"
+        return "model_parallel"
+
+    # -- ranks (single-controller: coordinates only exist in spmd regions) --
+    def get_data_parallel_rank(self):
+        return self._dp_group.rank
+
+    def get_model_parallel_rank(self):
+        return self._mp_group.rank
+
+    def get_stage_id(self):
+        return self._pp_group.rank
+
+    def get_sharding_parallel_rank(self):
+        return self._sharding_group.rank
+
+    # -- groups -------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self):
+        return self._mp_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def topology(self):
+        return self.mesh

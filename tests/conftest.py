@@ -7,13 +7,18 @@ precision to HIGHEST (TPU default bf16 matmuls would break finite-difference
 gradient comparisons)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the environment presets JAX_PLATFORMS=axon (TPU tunnel) and
+# its sitecustomize imports jax at interpreter start, so env vars are too
+# late — switch platform via jax.config before any backend use. Unit tests
+# must run on the virtual 8-device CPU mesh regardless of hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: the eager path compiles one executable per

@@ -1,0 +1,365 @@
+"""Distributed tests on the 8-device CPU mesh (SURVEY.md §4: multi-device
+parity vs single-device results, the TPU analogue of the reference's
+multi-process collective harness ``test_collective_base.py``)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.collective import _default_group
+
+
+@pytest.fixture(autouse=True)
+def _fresh_groups():
+    yield
+
+
+def test_eight_devices_visible():
+    assert len(jax.devices()) == 8
+
+
+# ---------------------------------------------------------------------------
+# collectives — eager path (sharded arrays)
+# ---------------------------------------------------------------------------
+
+def test_all_reduce_sum_eager():
+    g = _default_group()
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    dist.all_reduce(x)
+    # postcondition: every per-rank shard holds the sum of all shards
+    np.testing.assert_allclose(x.numpy(), np.full(8, np.arange(8).sum(), np.float32))
+
+
+def test_all_reduce_max_min():
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    dist.all_reduce(x, op=dist.ReduceOp.MAX)
+    np.testing.assert_allclose(x.numpy(), np.full(8, 7, np.float32))
+    y = paddle.to_tensor(np.arange(8, dtype=np.float32) + 1)
+    dist.all_reduce(y, op=dist.ReduceOp.PROD)
+    np.testing.assert_allclose(y.numpy(), np.full(8, np.prod(np.arange(8) + 1.0)))
+
+
+def test_broadcast_eager():
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    dist.broadcast(x, src=3)
+    np.testing.assert_allclose(x.numpy(), np.full(8, 3, np.float32))
+
+
+def test_reduce_to_dst():
+    x = paddle.to_tensor(np.ones(8, np.float32))
+    dist.reduce(x, dst=2)
+    expect = np.ones(8, np.float32)
+    expect[2] = 8.0
+    np.testing.assert_allclose(x.numpy(), expect)
+
+
+def test_all_gather_eager():
+    out = []
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    dist.all_gather(out, x)
+    assert len(out) == 8
+    for i, t in enumerate(out):
+        np.testing.assert_allclose(t.numpy(), [i])
+
+
+def test_reduce_scatter_eager():
+    # sharded-array model: [8, 8] = 8 rank-shards of [8]; rank i ends with
+    # sum_j shard_j[i] — all ones → every rank's piece is 8
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    out = dist.reduce_scatter(x)
+    np.testing.assert_allclose(np.asarray(out.numpy()).ravel(), np.full(8, 8.0))
+
+
+def test_scatter_eager():
+    parts = [paddle.to_tensor(np.full((1, 2), i, np.float32)) for i in range(8)]
+    x = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    dist.scatter(x, parts, src=0)
+    got = x.numpy().reshape(8, 2)
+    np.testing.assert_allclose(got, np.arange(8, dtype=np.float32)[:, None].repeat(2, 1))
+
+
+def test_alltoall_single_eager():
+    # [8, 8]: rank r owns row r; piece exchange ≙ block transpose
+    x = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+    out = dist.alltoall_single(x)
+    np.testing.assert_allclose(
+        out.numpy().reshape(8, 8), np.arange(64, dtype=np.float32).reshape(8, 8).T
+    )
+
+
+def test_barrier_and_wait():
+    dist.barrier()
+    t = paddle.ones([4])
+    dist.wait(t)
+
+
+# ---------------------------------------------------------------------------
+# collectives — inside shard_map (the c_* ops in a Program position)
+# ---------------------------------------------------------------------------
+
+def test_collectives_in_shard_map():
+    g = _default_group()
+
+    def body(x):
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t)
+        return t._value
+
+    f = shard_map(body, mesh=g.mesh, in_specs=(P(g.axis_name),), out_specs=P(g.axis_name), check_vma=False)
+    out = f(jnp.arange(8.0))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_ppermute_ring_via_send_recv_shapes():
+    g = _default_group()
+
+    def body(x):
+        from paddle_tpu.distributed.collective import _shift
+
+        return _shift(paddle.to_tensor(x), g, 1)
+
+    f = shard_map(body, mesh=g.mesh, in_specs=(P(g.axis_name),), out_specs=P(g.axis_name), check_vma=False)
+    out = np.asarray(f(jnp.arange(8.0)))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+# ---------------------------------------------------------------------------
+# DataParallel parity: sharded-batch training == single-device training
+# ---------------------------------------------------------------------------
+
+def _train(model, xs, ys, wrap_dp):
+    paddle.seed(7)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    m = dist.DataParallel(model) if wrap_dp else model
+    losses = []
+    for x, y in zip(xs, ys):
+        out = m(paddle.to_tensor(x))
+        loss = ((out - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses, [p.numpy().copy() for p in model.parameters()]
+
+
+def test_data_parallel_parity_with_single_device():
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(16, 4).astype(np.float32) for _ in range(5)]
+    ys = [rng.randn(16, 2).astype(np.float32) for _ in range(5)]
+
+    paddle.seed(3)
+    m1 = nn.Linear(4, 2)
+    paddle.seed(3)
+    m2 = nn.Linear(4, 2)
+    # identical init
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+    l_single, w_single = _train(m1, xs, ys, wrap_dp=False)
+    l_dp, w_dp = _train(m2, xs, ys, wrap_dp=True)
+    np.testing.assert_allclose(l_single, l_dp, rtol=1e-5)
+    for a, b in zip(w_single, w_dp):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+def test_communicate_topology_coords():
+    topo = dist.CommunicateTopology(["data", "pipe", "model"], [2, 2, 2])
+    assert topo.world_size() == 8
+    assert topo.get_rank(data=1, pipe=0, model=1) == 5
+    assert topo.get_coord(5) == (1, 0, 1)
+    rings = topo.get_comm_list("model")
+    assert [0, 1] in rings and [6, 7] in rings
+    assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+
+
+def test_hybrid_communicate_group_mesh():
+    hcg = dist.HybridCommunicateGroup(dp_degree=2, mp_degree=2, pp_degree=2)
+    assert hcg.get_data_parallel_world_size() == 2
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert hcg.get_parallel_mode() == "pipeline_parallel"
+    assert hcg.mesh.devices.size == 8
+    g = hcg.get_model_parallel_group()
+    assert g.nranks == 2
+
+
+# ---------------------------------------------------------------------------
+# TP layers: parity with dense equivalents
+# ---------------------------------------------------------------------------
+
+def test_column_row_parallel_linear_parity():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs["mp_degree"] = 8
+    fleet.fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(11)
+    col = dist.meta_parallel.ColumnParallelLinear(16, 32, gather_output=True)
+    row = dist.meta_parallel.RowParallelLinear(32, 16, input_is_parallel=False)
+
+    x = paddle.randn([4, 16])
+    y = col(x)
+    assert y.shape == [4, 32]
+    z = row(y)
+    assert z.shape == [4, 16]
+
+    # parity against dense matmul with the same (gathered) weights
+    y_ref = x.numpy() @ col.weight.numpy() + col.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), y_ref, rtol=2e-5, atol=1e-5)
+    z_ref = y_ref @ row.weight.numpy() + row.bias.numpy()
+    np.testing.assert_allclose(z.numpy(), z_ref, rtol=2e-5, atol=1e-5)
+
+    # gradients flow through sharded weights
+    z.sum().backward()
+    assert col.weight.grad is not None and row.weight.grad is not None
+
+
+def test_vocab_parallel_embedding_parity():
+    paddle.seed(12)
+    emb = dist.meta_parallel.VocabParallelEmbedding(64, 8)
+    ids = paddle.to_tensor(np.array([[1, 5, 63], [0, 32, 31]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 3, 8]
+    np.testing.assert_allclose(out.numpy(), emb.weight.numpy()[ids.numpy()], rtol=1e-6)
+
+
+def test_parallel_cross_entropy_spmd_matches_dense():
+    from paddle_tpu.distributed.meta_parallel.mp_layers import parallel_softmax_ce_spmd
+
+    g = _default_group()
+    rng = np.random.RandomState(5)
+    logits = rng.randn(4, 64).astype(np.float32)
+    labels = rng.randint(0, 64, (4,))
+
+    f = shard_map(
+        lambda lg, lb: parallel_softmax_ce_spmd(lg, lb, g.axis_name),
+        mesh=g.mesh,
+        in_specs=(P(None, g.axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    got = np.asarray(f(jnp.asarray(logits), jnp.asarray(labels)))
+    # dense reference
+    m = logits.max(-1, keepdims=True)
+    lse = np.log(np.exp(logits - m).sum(-1)) + m[:, 0]
+    expect = lse - logits[np.arange(4), labels]
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# group_sharded (ZeRO) parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_group_sharded_parity(level):
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(16, 8).astype(np.float32) for _ in range(4)]
+    ys = [rng.randn(16, 8).astype(np.float32) for _ in range(4)]
+
+    def build():
+        paddle.seed(21)
+        m = nn.Linear(8, 8)
+        o = paddle.optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+        return m, o
+
+    m_ref, o_ref = build()
+    for x, y in zip(xs, ys):
+        loss = ((m_ref(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o_ref.step()
+        o_ref.clear_grad()
+
+    m, o = build()
+    m, o, _ = dist.sharding.group_sharded_parallel(m, o, level=level)
+    for x, y in zip(xs, ys):
+        loss = ((m(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+
+    for (n1, p1), (n2, p2) in zip(m_ref.named_parameters(), m.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-5, atol=1e-6, err_msg=n1)
+
+
+# ---------------------------------------------------------------------------
+# fleet facade
+# ---------------------------------------------------------------------------
+
+def test_fleet_dp_end_to_end():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    fleet.fleet.init(is_collective=True, strategy=strategy)
+    assert fleet.fleet.worker_num() >= 1
+
+    paddle.seed(5)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    model = fleet.fleet.distributed_model(model)
+    opt = fleet.fleet.distributed_optimizer(opt)
+
+    x = paddle.randn([16, 4])
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# review-found paths: rank inside spmd, eager p2p channel, rs list form
+# ---------------------------------------------------------------------------
+
+def test_group_rank_traced_in_spmd():
+    g = _default_group()
+
+    def body(x):
+        return x + g.rank
+
+    f = shard_map(body, mesh=g.mesh, in_specs=(P(g.axis_name),), out_specs=P(g.axis_name), check_vma=False)
+    out = np.asarray(f(jnp.zeros(8)))
+    np.testing.assert_allclose(out, np.arange(8.0))
+
+
+def test_eager_send_recv_moves_data():
+    t = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    buf = paddle.to_tensor(np.zeros(8, np.float32))
+    dist.send(t, dst=1)
+    dist.recv(buf, src=0)
+    np.testing.assert_allclose(buf.numpy(), np.arange(8, dtype=np.float32))
+
+
+def test_recv_without_send_raises():
+    with pytest.raises(RuntimeError):
+        dist.recv(paddle.zeros([4]), src=0)
+
+
+def test_reduce_scatter_tensor_list_form():
+    out = paddle.zeros([8, 2])
+    parts = [paddle.to_tensor(np.full((2,), i, np.float32)) for i in range(8)]
+    dist.reduce_scatter(out, parts)
+    got = out.numpy().reshape(8, 2)
+    # all "ranks" contribute the same list → rank i gets nranks * entry i
+    np.testing.assert_allclose(got, 8.0 * np.arange(8, dtype=np.float32)[:, None].repeat(2, 1))
+
+
+def test_spmd_recv_relative_offset():
+    g = _default_group()
+
+    def body(x):
+        t = paddle.to_tensor(x)
+        return dist.recv(t, src=1, group=g)._value  # receive from rank-1
+
+    f = shard_map(body, mesh=g.mesh, in_specs=(P(g.axis_name),), out_specs=P(g.axis_name), check_vma=False)
+    out = np.asarray(f(jnp.arange(8.0)))
+    np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
