@@ -1,0 +1,105 @@
+"""Driver benchmark: flagship GPT train-step throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline
+compares against the previous round's BENCH_r*.json when present, else 1.0.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    # GPT-2 small (124M); bf16 compute on TPU
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=50304, hidden_size=768, num_layers=12, num_heads=12,
+            max_position_embeddings=1024, hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        batch, seq = 8, 1024
+    else:  # smoke-scale for CPU runs
+        cfg = GPTConfig(
+            vocab_size=1024, hidden_size=128, num_layers=2, num_heads=4,
+            max_position_embeddings=128, hidden_dropout=0.0, attention_dropout=0.0,
+        )
+        batch, seq = 4, 64
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+        # keep layernorms fp32 for stability
+        for name, sub in model.named_sublayers():
+            if type(sub).__name__ == "LayerNorm":
+                sub.to(dtype="float32")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def train_step(ids, labels):
+        logits = model(ids)
+        loss = F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]).astype("float32"),
+            labels.reshape([-1, 1]),
+        ).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = CompiledStep(train_step, stateful=[model, opt], donate_state=True)
+
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = step(ids, labels)
+    loss._value.block_until_ready()
+
+    iters = 10 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    loss._value.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+
+    prev = 0.0
+    for f in sorted(glob.glob("BENCH_r*.json")):
+        try:
+            d = json.load(open(f))
+            if d.get("unit") == "tokens/sec/chip":
+                prev = float(d.get("value", 0.0))
+        except Exception:
+            pass
+    vs = tokens_per_sec / prev if prev > 0 else 1.0
+
+    print(json.dumps({
+        "metric": f"gpt2-124M train throughput ({backend})" if on_tpu
+                  else f"gpt-smoke train throughput ({backend})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,7 @@ class PipelineLayer(Layer):
         recompute_interval=0,
         recompute_ctx=None,
         num_virtual_pipeline_stages=None,
+        placement="mesh",
     ):
         super().__init__()
         self._loss_fn = loss_fn
@@ -96,8 +97,17 @@ class PipelineLayer(Layer):
         # partition into stages (reference segment: uniform by layer count;
         # 'layer:<ClassName>' pins boundaries at occurrences of a class)
         self._stage_of = self._segment(seg_method)
-        if self._mesh is not None and self._num_stages > 1:
-            self._place_stages()
+        # placement="submesh": each stage's params live only on its pp-slice
+        # (eager memory locality, ≙ per-rank stage build). "mesh" (default):
+        # params stay on the FULL mesh (replicated or mp-sharded) so one
+        # jitted SPMD program can ingest them — the jit 1F1B schedule
+        # (stacked-stage scan + ppermute) owns pipelining there.
+        self._placement = placement
+        if self._mesh is not None:
+            if self._num_stages > 1 and placement == "submesh":
+                self._place_stages()
+            else:
+                self._place_mesh()
 
     # -- partitioning --------------------------------------------------------
     def _segment(self, seg_method):
@@ -150,6 +160,15 @@ class PipelineLayer(Layer):
         sub_mesh = Mesh(sub, axis_names=names)
         return NamedSharding(sub_mesh, P())
 
+    def _place_mesh(self):
+        """Full-mesh placement: mp-sharded params keep their sharding;
+        everything else replicates over the whole mesh (one device set →
+        one jitted SPMD program)."""
+        repl = NamedSharding(self._mesh, P())
+        for p in self.parameters(include_sublayers=True):
+            if not getattr(p, "is_distributed", False):
+                p._value = jax.device_put(p._value, repl)
+
     def _place_stages(self):
         shared_ids = {id(l) for l in self._shared.values()}
         for i, (l, _) in enumerate(self.run_functions):
@@ -175,6 +194,7 @@ class PipelineLayer(Layer):
             if (
                 self._mesh is not None
                 and self._num_stages > 1
+                and self._placement == "submesh"
                 and s != cur_stage
             ):
                 # activation hop to the next stage's devices ≙ send/recv_v2;

@@ -1,0 +1,20 @@
+"""Flagship model families (reference: the fleet hybrid-parallel rank
+scripts ``unittests/hybrid_parallel_mp_model.py`` / ``hybrid_parallel_pp_transformer.py``
+and the ERNIE/GPT configs those tests model)."""
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForCausalLM,
+    GPTDecoderLayer,
+    GPTEmbeddings,
+    build_gpt_pipeline_descs,
+)
+
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "GPTForCausalLM",
+    "GPTDecoderLayer",
+    "GPTEmbeddings",
+    "build_gpt_pipeline_descs",
+]
