@@ -2,13 +2,23 @@
 ``paddle/fluid/operators/amp/check_finite_and_unscale_op.*``,
 ``update_loss_scaling_op.*``).
 
-Real dynamic loss scaling is implemented (needed for fp16); for bf16 — the TPU
-default — scaling is mathematically unnecessary, so ``enable=False`` or
-bf16 usage makes this a cheap passthrough with identical API."""
+TPU-native design: everything is traced. The reference implements
+check_finite_and_unscale / update_loss_scaling as *ops* that run entirely on
+device; here the scaler state (scale, good/bad step counters, found_inf) is a
+pytree of jnp arrays, the inf-skip is a ``jnp.where`` select over the
+post-step parameters/accumulators, and the dynamic-scale update is pure
+``jnp.where`` arithmetic. That makes the scaler safe inside
+``jit.functionalize`` (one compiled step) and free of per-step host syncs in
+eager. Host-visible accessors (``get_init_loss_scaling``, ``state_dict``)
+sync only when called.
+
+For bf16 — the TPU default — loss scaling is mathematically unnecessary;
+``enable=False`` keeps the identical API as a passthrough.
+"""
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.tensor import Tensor
 
@@ -27,15 +37,15 @@ class AmpScaler:
         use_dynamic_loss_scaling=True,
     ):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
+        self._scale = jnp.asarray(float(init_loss_scaling), jnp.float32)
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every_n_steps = incr_every_n_steps
         self._decr_every_n = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
-        self._found_inf = False
+        self._good_steps = jnp.asarray(0, jnp.int32)
+        self._bad_steps = jnp.asarray(0, jnp.int32)
+        self._found_inf = jnp.asarray(False)
         # optimizers already unscaled this step (guards the documented
         # `scaler.unscale_(opt); clip; scaler.step(opt)` recipe against a
         # second division by the scale — reference grad_scaler.py tracks
@@ -51,20 +61,23 @@ class AmpScaler:
     def scale(self, var):
         if not self._enable:
             return var
-        return var * self._scale
+        return var * Tensor(self._scale)
 
     def unscale_(self, optimizer):
         if not self._enable or id(optimizer) in self._unscaled:
             return
-        inv = 1.0 / self._scale
-        found = False
+        inv = (1.0 / self._scale).astype(jnp.float32)
+        flags = []
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
-            g = p.grad._value * inv
+            g = p.grad._value * inv.astype(p.grad._value.dtype)
             p.grad._value = g
-            found = found or bool(jnp.any(~jnp.isfinite(g)))
-        self._found_inf = found
+            flags.append(jnp.any(~jnp.isfinite(g)))
+        if flags:
+            self._found_inf = jnp.stack(flags).any()
+        else:
+            self._found_inf = jnp.asarray(False)
         self._unscaled.add(id(optimizer))
 
     def minimize(self, optimizer, loss, *args, **kwargs):
@@ -77,33 +90,68 @@ class AmpScaler:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
-            optimizer.step()
+        found = self._found_inf
+        # Trace-safe skip: run the update, then select the pre-step value for
+        # every param and accumulator when an inf/nan was found (the
+        # reference's check_finite_and_unscale gates the optimize op the same
+        # way, just at graph level).
+        params = [p for p in optimizer._parameter_list or []]
+        pre_params = [p._value for p in params]
+        pre_accs = {
+            name: dict(store) for name, store in optimizer._accumulators.items()
+        }
+        optimizer.step()
+        for p, old in zip(params, pre_params):
+            p._value = jnp.where(found, old, p._value)
+        for name, store in optimizer._accumulators.items():
+            pre_store = pre_accs.get(name, {})
+            for key, new in store.items():
+                old = pre_store.get(key)
+                if old is None:
+                    # accumulator born during this step — its pre-step value
+                    # is its recorded init fill
+                    fill, shape, dtype = optimizer._acc_meta[(name, key)]
+                    old = jnp.full(shape, fill, dtype)
+                store[key] = jnp.where(found, old, new)
 
     def update(self):
         self._unscaled.clear()
         if not (self._enable and self._dynamic):
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
-        self._found_inf = False
+        found = self._found_inf
+        bad = jnp.where(found, self._bad_steps + 1, 0).astype(jnp.int32)
+        good = jnp.where(found, 0, self._good_steps + 1).astype(jnp.int32)
+        decr = bad >= self._decr_every_n
+        incr = good >= self._incr_every_n_steps
+        scale = self._scale
+        scale = jnp.where(decr, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        scale = jnp.where(incr, scale * self._incr_ratio, scale)
+        self._scale = scale
+        self._bad_steps = jnp.where(decr, 0, bad).astype(jnp.int32)
+        self._good_steps = jnp.where(incr, 0, good).astype(jnp.int32)
+        self._found_inf = jnp.asarray(False)
 
-    # -- scale accessors (reference API) ------------------------------------
+    # -- jit functionalization hooks ----------------------------------------
+    def _state_pytree(self):
+        return {
+            "scale": self._scale,
+            "good": self._good_steps,
+            "bad": self._bad_steps,
+            "found_inf": self._found_inf,
+        }
+
+    def _load_state_pytree(self, tree):
+        self._scale = tree["scale"]
+        self._good_steps = tree["good"]
+        self._bad_steps = tree["bad"]
+        self._found_inf = tree["found_inf"]
+
+    # -- scale accessors (reference API; host-syncing) -----------------------
     def get_init_loss_scaling(self):
-        return self._scale
+        return float(np.asarray(self._scale))
 
     def set_init_loss_scaling(self, v):
-        self._scale = float(v)
+        self._scale = jnp.asarray(float(v), jnp.float32)
 
     def get_incr_ratio(self):
         return self._incr_ratio
@@ -131,20 +179,20 @@ class AmpScaler:
 
     def state_dict(self):
         return {
-            "scale": self._scale,
+            "scale": float(np.asarray(self._scale)),
             "incr_ratio": self._incr_ratio,
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every_n_steps,
             "decr_every_n_nan_or_inf": self._decr_every_n,
-            "good_steps": self._good_steps,
-            "bad_steps": self._bad_steps,
+            "good_steps": int(np.asarray(self._good_steps)),
+            "bad_steps": int(np.asarray(self._bad_steps)),
             "use_dynamic_loss_scaling": self._dynamic,
         }
 
     def load_state_dict(self, sd):
-        self._scale = sd.get("scale", self._scale)
-        self._good_steps = sd.get("good_steps", 0)
-        self._bad_steps = sd.get("bad_steps", 0)
+        self._scale = jnp.asarray(sd.get("scale", self.get_init_loss_scaling()), jnp.float32)
+        self._good_steps = jnp.asarray(sd.get("good_steps", 0), jnp.int32)
+        self._bad_steps = jnp.asarray(sd.get("bad_steps", 0), jnp.int32)
 
 
 class GradScaler(AmpScaler):

@@ -462,9 +462,11 @@ def _shift(tensor, group, offset):
 
 
 # eager p2p channel: single-controller send/recv pairs execute sequentially
-# in one process, so a FIFO per group delivers the actual payload (the
-# reference's socket/NCCL channel collapses to a queue)
-_P2P_CHANNEL: dict[int, list] = {}
+# in one process, so a FIFO per (group, dst rank) delivers the actual payload
+# (the reference's socket/NCCL channel collapses to a queue); keying on the
+# destination keeps interleaved sends to different destinations paired with
+# the right recv
+_P2P_CHANNEL: dict[tuple, list] = {}
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
@@ -472,7 +474,8 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
     XLA has no true p2p; the two supported idioms are:
       * eager — the paired :func:`recv` in the same process pops the payload
-        from a per-group FIFO (single-controller: both ends live here);
+        from a FIFO keyed on (group, dst) (single-controller: both ends live
+        here);
       * spmd  — use :func:`recv` with a *relative* ``src`` offset (the
         uniform-ring pattern of PP schedules), or ``lax.ppermute`` directly
         for irregular patterns. ``send`` itself is a no-op in spmd: the
@@ -480,28 +483,40 @@ def send(tensor, dst=0, group=None, sync_op=True):
     """
     g = group or _default_group()
     if not _in_spmd(g.axis_name):
-        _P2P_CHANNEL.setdefault(g.id, []).append(_unwrap(tensor))
+        _P2P_CHANNEL.setdefault((g.id, int(dst)), []).append(_unwrap(tensor))
     return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     """Point-to-point receive (reference ``collective.py:2096`` / recv_v2).
 
-    Eager: pops the payload queued by the paired :func:`send` (FIFO per
-    group). Spmd: ``src`` is the *relative* ring offset to receive from
-    (``src=1`` ⇒ rank r gets rank r-1's value ≙ ppermute shift by +1) —
-    absolute-rank scattered p2p should use ``lax.ppermute`` directly.
+    Eager: pops the payload queued by the paired :func:`send` whose ``dst``
+    names this receiver (single-controller: the receiving "rank" is the
+    group's current rank, 0 outside spmd). Spmd: ``src`` is the *relative*
+    ring offset to receive from (``src=1`` ⇒ rank r gets rank r-1's value ≙
+    ppermute shift by +1) — absolute-rank scattered p2p should use
+    ``lax.ppermute`` directly.
     """
     g = group or _default_group()
     if _in_spmd(g.axis_name):
         return _ret(tensor, _shift(tensor, g, src))
-    chan = _P2P_CHANNEL.get(g.id)
-    if not chan:
+    # single-controller pairing: when exactly one destination has pending
+    # sends, play that rank (the classic send(dst=1); recv() simulation).
+    # Multiple pending destinations are ambiguous — the receiver has no rank
+    # identity in eager — so raise instead of misdelivering.
+    pending = [k for k, v in _P2P_CHANNEL.items() if k[0] == g.id and v]
+    if len(pending) > 1:
+        raise RuntimeError(
+            "recv() on group %d is ambiguous: pending sends to ranks %s — "
+            "receive them in destination order or use spmd p2p"
+            % (g.id, sorted(k[1] for k in pending))
+        )
+    if not pending:
         raise RuntimeError(
             "recv() without a pending send() on group %d (eager p2p pairs "
             "must be issued in order)" % g.id
         )
-    return _ret(tensor, chan.pop(0))
+    return _ret(tensor, _P2P_CHANNEL[pending[0]].pop(0))
 
 
 class _Task:

@@ -18,8 +18,11 @@ _PROTO = 4
 
 
 def _to_serializable(obj):
+    # Tensors pickle as bare ndarrays — the reference paddle.save format
+    # (state_dict values are plain numpy), so .pdparams files interchange
+    # with upstream checkpoints.
     if isinstance(obj, Tensor):
-        return {"__tensor__": True, "value": np.asarray(obj._value), "name": obj.name}
+        return np.asarray(obj._value)
     if isinstance(obj, dict):
         return {k: _to_serializable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -31,8 +34,10 @@ def _to_serializable(obj):
 
 
 def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, np.ndarray):
+        return obj if return_numpy else Tensor(obj)
     if isinstance(obj, dict):
-        if obj.get("__tensor__"):
+        if obj.get("__tensor__"):  # legacy round-1 wrapper format
             if return_numpy:
                 return obj["value"]
             t = Tensor(obj["value"])

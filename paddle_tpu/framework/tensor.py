@@ -287,6 +287,13 @@ class Parameter(Tensor):
     persistable, carries optimizer attributes."""
 
     def __init__(self, value, name=None, trainable=True):
+        if not name:
+            # Stable auto-name (reference fluid/unique_name.py): optimizer
+            # state keys on param names must match across processes, so the
+            # key comes from deterministic creation order, never id().
+            from ..utils import unique_name
+
+            name = unique_name.generate("param")
         super().__init__(value, stop_gradient=not trainable, name=name)
         self.persistable = True
         self.is_leaf_param = True

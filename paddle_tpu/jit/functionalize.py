@@ -56,12 +56,19 @@ class _StateSpec:
     def __init__(self, stateful):
         self.layers = [s for s in stateful if isinstance(s, Layer)]
         self.optimizers = [s for s in stateful if isinstance(s, Optimizer)]
+        # anything else exposing the _state_pytree protocol (e.g. GradScaler)
+        self.others = [
+            s
+            for s in stateful
+            if not isinstance(s, (Layer, Optimizer)) and hasattr(s, "_state_pytree")
+        ]
         self._refs = [_layer_refs(l) for l in self.layers]
 
     def snapshot(self):
         return {
             "layers": [_layer_state(l) for l in self.layers],
             "optimizers": [o._state_pytree() for o in self.optimizers],
+            "others": [o._state_pytree() for o in self.others],
             "rng": rnd.default_generator.get_state(),
         }
 
@@ -72,6 +79,8 @@ class _StateSpec:
             for name, b in refs["buffers"].items():
                 b._value = st["buffers"][name]
         for o, st in zip(self.optimizers, tree["optimizers"]):
+            o._load_state_pytree(st)
+        for o, st in zip(self.others, tree.get("others", [])):
             o._load_state_pytree(st)
         rnd.default_generator.set_state(tree["rng"])
 

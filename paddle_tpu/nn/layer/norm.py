@@ -93,20 +93,43 @@ class SyncBatchNorm(_BatchNormBase):
     reduction happens over the mesh data axis via psum (see
     paddle_tpu.distributed); in single-device eager it equals BatchNorm."""
 
+    @staticmethod
+    def _candidate_axes():
+        """Mesh axes the cross-replica reduction may ride: the fleet
+        data-parallel axis when a hybrid topology is initialized, the 'dp'
+        convention, and the default world group's axis."""
+        axes = []
+        try:
+            from ...distributed import fleet
+
+            hcg = fleet.get_hybrid_communicate_group()
+            if hcg is not None:
+                axes.append(hcg.get_data_parallel_group().axis_name)
+        except Exception:
+            pass
+        axes.append("dp")
+        try:
+            from ...distributed.collective import _default_group
+
+            axes.append(_default_group().axis_name)
+        except Exception:
+            pass
+        return axes
+
     def forward(self, input):
         from ...distributed import collective as coll
 
-        if coll._in_spmd_context():
-            return self._spmd_forward(input)
+        if self.training:
+            for axis_name in self._candidate_axes():
+                if coll._in_spmd(axis_name):
+                    return self._spmd_forward(input, axis_name)
         return super().forward(input)
 
-    def _spmd_forward(self, input):
-        import jax
-
+    def _spmd_forward(self, input, axis_name):
         from ...ops.dispatch import op as _op
 
         axis = 1
-        eps, mom = self._epsilon, self._momentum
+        eps = self._epsilon
 
         @_op("sync_batch_norm")
         def _sync_bn(x, w, b):
@@ -115,15 +138,37 @@ class SyncBatchNorm(_BatchNormBase):
 
             local_mean = jnp.mean(x, axis=axes)
             local_sq = jnp.mean(jnp.square(x), axis=axes)
-            mean = lax.pmean(local_mean, "dp")
-            sq = lax.pmean(local_sq, "dp")
+            mean = lax.pmean(local_mean, axis_name)
+            sq = lax.pmean(local_sq, axis_name)
             var = sq - jnp.square(mean)
             shape = [1] * x.ndim
             shape[axis] = x.shape[axis]
             scale = w.reshape(shape) * lax.rsqrt(var.reshape(shape) + eps)
-            return x * scale + (b.reshape(shape) - mean.reshape(shape) * scale)
+            out = x * scale + (b.reshape(shape) - mean.reshape(shape) * scale)
+            # running buffers store the *unbiased* variance over the global
+            # batch (matching F.batch_norm), normalization uses biased
+            n_g = (x.size // x.shape[axis]) * lax.axis_size(axis_name)
+            var_unbiased = var * (n_g / max(n_g - 1, 1))
+            return out, mean, var_unbiased
 
-        return _sync_bn(input, self.weight, self.bias)
+        out, mean, var = _sync_bn(input, self.weight, self.bias)
+        # Running-stat update with the cross-replica batch stats, so eval
+        # (which reads the buffers via super().forward) sees learned
+        # population statistics. Inside a shard_map region these are traced
+        # values: the enclosing functionalization (CompiledStep state
+        # threading, or a shard_map body that returns the buffers) carries
+        # them out — the same contract as every other mutable buffer.
+        mom = self._momentum
+        mv = mean._value if isinstance(mean, Tensor) else mean
+        vv = var._value if isinstance(var, Tensor) else var
+        self._mean._value = (
+            mom * self._mean._value + (1.0 - mom) * mv.astype(self._mean._value.dtype)
+        )
+        self._variance._value = (
+            mom * self._variance._value
+            + (1.0 - mom) * vv.astype(self._variance._value.dtype)
+        )
+        return out
 
     @classmethod
     def convert_sync_batchnorm(cls, layer):

@@ -48,6 +48,8 @@ class Optimizer:
         self._weight_decay = weight_decay
         self._grad_clip = grad_clip
         self._accumulators = {}
+        self._acc_meta = {}  # (name, key) -> (fill_value, shape, dtype)
+        self._pending_state = {}
         self._name = name or type(self).__name__
         self._step_count = 0
 
@@ -71,16 +73,36 @@ class Optimizer:
     # -- accumulators --------------------------------------------------------
     @staticmethod
     def _pkey(p):
-        return p.name or f"@{id(p)}"
+        # Parameters are auto-named at creation (framework/tensor.py) so this
+        # is a stable, process-portable key. Plain Tensors used as parameters
+        # get a name on first touch — deterministic in optimizer order.
+        if not p.name:
+            from ..utils import unique_name
+
+            p.name = unique_name.generate("param")
+        return p.name
 
     def _add_accumulator(self, name, param, fill_value=0.0, dtype=None, shape=None):
         store = self._accumulators.setdefault(name, {})
         key = self._pkey(param)
         if key not in store:
-            store[key] = jnp.full(
-                shape if shape is not None else tuple(param.shape),
+            pending = self._pending_state.pop(f"{key}_{name}", None)
+            if pending is not None:
+                # restore-before-first-step: set_state_dict ran before this
+                # accumulator was lazily created
+                store[key] = jnp.asarray(pending)
+            else:
+                store[key] = jnp.full(
+                    shape if shape is not None else tuple(param.shape),
+                    fill_value,
+                    dtype or (param._value.dtype if dtypes.is_floating(param.dtype) else jnp.float32),
+                )
+            # GradScaler's inf-skip needs the pre-step value of accumulators
+            # born mid-step; keep only metadata, never a full-size buffer.
+            self._acc_meta[(name, key)] = (
                 fill_value,
-                dtype or (param._value.dtype if dtypes.is_floating(param.dtype) else jnp.float32),
+                tuple(store[key].shape),
+                store[key].dtype,
             )
         return store[key]
 
@@ -178,17 +200,20 @@ class Optimizer:
             self._step_count = int(state_dict["@step"])
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        applied = set()
         for name, store in self._accumulators.items():
             for key in store:
                 k = f"{key}_{name}"
                 if k in state_dict:
                     v = state_dict[k]
                     store[key] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
-        # also allow loading accumulators created lazily later
+                    applied.add(k)
+        # entries for accumulators not yet created are held back and consumed
+        # by _add_accumulator on first touch (lazy creation after restore)
         self._pending_state = {
             k: (v._value if isinstance(v, Tensor) else v)
             for k, v in state_dict.items()
-            if k not in ("@step", "LR_Scheduler")
+            if k not in ("@step", "LR_Scheduler") and k not in applied
         }
 
     # -- jit functionalization hooks ----------------------------------------

@@ -1,0 +1,48 @@
+"""Stable auto-generated names (reference ``python/paddle/utils/unique_name.py``,
+backed by ``fluid/unique_name.py`` UniqueNameGenerator).
+
+Parameters get deterministic names ("param_0", "linear_1.w_0"-style prefixes)
+at creation so optimizer state_dict keys are portable across processes —
+model construction order, not id(), defines the key.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self._ids = {}
+
+    def __call__(self, key: str) -> str:
+        n = self._ids.get(key, 0)
+        self._ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+_generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Replace the global generator, returning the old one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope a fresh generator (reference unique_name.guard) so name counters
+    restart — used by tests constructing twin models that must share keys."""
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)
