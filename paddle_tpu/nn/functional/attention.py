@@ -1,9 +1,14 @@
 """Attention functionals.
 
 Reference fused kernels: ``paddle/fluid/operators/fused/fused_attention_op.cu``
-and ``fmha_ref.h``. TPU-native path: a Pallas flash-attention kernel
-(``paddle_tpu.ops.pallas.flash_attention``) for long sequences, with an XLA
-einsum fallback for small/odd shapes."""
+and ``fmha_ref.h``. TPU-native path: the Pallas flash-attention kernel
+(``paddle_tpu.ops.pallas.flash_attention``) whenever shapes tile onto the MXU
+and no attention dropout is requested; an XLA einsum path otherwise.
+
+Routing is an EXPLICIT capability check (``_flash_ok``), never a silent
+``except`` fallback: if the Pallas kernel is selected and fails, the error
+propagates.
+"""
 from __future__ import annotations
 
 import math
@@ -11,19 +16,55 @@ import math
 import jax
 import jax.numpy as jnp
 
+from ...framework import random as rnd
 from ...ops.dispatch import op
 
 
-@op("sdpa")
-def _sdpa_raw(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, use_pallas=True):
-    """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
-    if use_pallas:
-        try:
-            from ...ops.pallas.flash_attention import flash_attention_fwd
+def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
+    """Pallas flash path: TPU (or interpret-mode) backend, MXU-tileable
+    sequence lengths, no attention dropout (dropout needs the probs), and —
+    when a mask is given — a mask the kernel streams exactly: trailing dims
+    ``(sq, sk)`` with broadcastable batch/head dims, and not a trainable bias
+    (the fused backward does not produce a mask gradient)."""
+    from ...ops import pallas
 
-            return flash_attention_fwd(q, k, v, mask=mask, causal=causal, scale=scale)
-        except Exception:
-            pass
+    if dropout_p > 0.0 and training:
+        return False
+    sq, sk = q_shape[1], k_shape[1]
+    if mask is not None:
+        if getattr(mask, "stop_gradient", True) is False:
+            return False  # learned bias: einsum path computes its gradient
+        ms = tuple(mask.shape)
+        if len(ms) == 4:
+            if ms[2:] != (sq, sk):
+                return False
+            if ms[0] not in (1, q_shape[0]) or ms[1] not in (1, q_shape[2]):
+                return False
+        elif ms != (sq, sk):
+            return False
+    if not pallas.is_available():
+        return False
+    from ...ops.pallas.flash_attention import supports
+
+    return supports(sq, sk, q_shape[3])
+
+
+@op("flash_sdpa")
+def _sdpa_flash(q, k, v, mask=None, causal=False, scale=None):
+    """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
+    from ...ops.pallas.flash_attention import flash_attention as fa
+
+    return fa(q, k, v, bias=mask, causal=causal, scale=scale)
+
+
+@op("sdpa")
+def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
+              dropout_p=0.0):
+    """XLA einsum path (small/odd shapes, or attention dropout active).
+
+    ``dropout_mask`` is a keep-mask drawn by the caller (so the op stays a
+    pure function of its inputs and remains jit-traceable).
+    """
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
@@ -37,19 +78,36 @@ def _sdpa_raw(q, k, v, mask=None, dropout_p=0.0, causal=False, scale=None, use_p
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_mask is not None:
+        probs = probs * dropout_mask.astype(probs.dtype) / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
+          training=True, scale=None):
+    if _flash_ok(query.shape, key.shape, attn_mask, dropout_p, training):
+        return _sdpa_flash(query, key, value, attn_mask, causal=is_causal,
+                           scale=scale)
+    dropout_mask = None
+    if dropout_p > 0.0 and training:
+        b, sq, h, _ = query.shape
+        sk = key.shape[1]
+        dropout_mask = jax.random.bernoulli(
+            rnd.next_key(), 1.0 - dropout_p, (b, h, sq, sk)
+        )
+    return _sdpa_raw(query, key, value, attn_mask, dropout_mask,
+                     causal=is_causal, scale=scale, dropout_p=dropout_p)
+
+
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
+    training=True, name=None
 ):
-    if attn_mask is not None:
-        return _sdpa_raw(query, key, value, attn_mask, dropout_p=dropout_p, causal=is_causal, use_pallas=False)
-    return _sdpa_raw(query, key, value, dropout_p=dropout_p, causal=is_causal)
+    return _sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)
 
 
-def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, fixed_seed_offset=None, rng_name="", training=True, name=None):
-    out = _sdpa_raw(query, key, value, dropout_p=dropout, causal=causal)
-    if return_softmax:
-        return out, None
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = _sdpa(query, key, value, None, dropout, causal, training)
     return out, None

@@ -29,9 +29,32 @@ def jax_rsqrt(v):
     return lax.rsqrt(v)
 
 
+@op("fused_layer_norm")
+def _layer_norm_pallas(x, weight, bias, epsilon=1e-5):
+    from ...ops.pallas import fused_layer_norm
+
+    return fused_layer_norm(x, weight, bias, eps=epsilon)
+
+
+def _pallas_ln_ok(normalized_shape, weight, bias):
+    """Fused Pallas LN: TPU backend, last-axis norm, affine, lane-aligned."""
+    from ...ops import pallas
+    from ...ops.pallas.layer_norm import supports
+
+    return (
+        len(normalized_shape) == 1
+        and weight is not None
+        and bias is not None
+        and supports(normalized_shape[0])
+        and pallas.is_available()
+    )
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
+    if _pallas_ln_ok(normalized_shape, weight, bias):
+        return _layer_norm_pallas(x, weight, bias, epsilon=epsilon)
     begin = x.ndim - len(normalized_shape)
     args = [x]
     has_w = weight is not None

@@ -1,0 +1,62 @@
+"""Pallas TPU kernels — the hot fused ops.
+
+TPU-native replacement for the reference's hand-written CUDA fused kernels
+(``paddle/fluid/operators/fused/fused_attention_op.cu``, ``fmha_ref.h``,
+``fused_softmax_mask.cu.h``, fused layernorm inside
+``fused_attention_op.cu``): here each fused op is a Pallas kernel tiled for
+MXU/VMEM, with a custom VJP so the backward is fused too.
+
+Capability gating is EXPLICIT (no silent fallbacks): :func:`is_available`
+says whether the Mosaic TPU compile path exists for the current backend, and
+``interpret_mode()`` lets tests run the same kernels interpreted on CPU.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_FORCE_INTERPRET = False
+
+
+def interpret_requested() -> bool:
+    """True when Pallas kernels should run in interpreter mode (CPU tests)."""
+    return _FORCE_INTERPRET or os.environ.get("PADDLE_PALLAS_INTERPRET", "") == "1"
+
+
+class interpret_mode:
+    """Context manager forcing interpreter-mode Pallas (for CPU parity tests)."""
+
+    def __enter__(self):
+        global _FORCE_INTERPRET
+        self._prev = _FORCE_INTERPRET
+        _FORCE_INTERPRET = True
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_INTERPRET
+        _FORCE_INTERPRET = self._prev
+        return False
+
+
+def is_available() -> bool:
+    """Mosaic (compiled Pallas) needs a TPU backend; interpreter mode works
+    anywhere.  ``axon`` is the tunnelled single-TPU platform the driver uses."""
+    if interpret_requested():
+        return True
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+from .flash_attention import flash_attention  # noqa: E402
+from .layer_norm import fused_layer_norm  # noqa: E402
+
+__all__ = [
+    "flash_attention",
+    "fused_layer_norm",
+    "is_available",
+    "interpret_mode",
+    "interpret_requested",
+]

@@ -1,0 +1,447 @@
+"""Flash attention as a Pallas TPU kernel (forward + fused backward).
+
+TPU-native replacement for the reference CUDA fused attention
+(``paddle/fluid/operators/fused/fused_attention_op.cu``, ``fmha_ref.h``):
+blockwise online-softmax attention that never materializes the ``[b,h,s,s]``
+logits in HBM.  The grid iterates ``(batch, head, q_block, k_block)`` with the
+running ``(m, l, acc)`` state held in VMEM scratch across the innermost
+k-block sweep — the canonical TPU flash schedule: both matmuls per tile hit
+the MXU, softmax runs on the VPU, HBM traffic is O(s·d) not O(s²).
+
+Backward is two fused kernels (dq swept over k-blocks; dk/dv swept over
+q-blocks) recomputing p from the saved logsumexp — the FlashAttention-2
+recurrence.
+
+Row statistics (logsumexp, delta) are stored lane-broadcast as
+``(b, h, s, 128)`` so every in-kernel operand is a natively-tileable 2-D
+block; head_dim is zero-padded to a lane multiple in the wrapper.
+
+Layout: public API takes paddle layout ``(batch, seq, heads, head_dim)``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+LANES = 128
+NEG_INF = -1e30
+
+
+def _causal_mask(s, qi, ki, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask (matches the einsum path's
+    ``tril(k=seq_k-seq_q)``): query row r attends keys <= r + offset where
+    ``offset = seq_k - seq_q``."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(cols <= rows + offset, s, NEG_INF)
+
+
+def _causal_run(qi, ki, block_q, block_k, offset):
+    """Does this (q_block, k_block) tile contain any unmasked entry?"""
+    return qi * block_q + block_q - 1 + offset >= ki * block_k
+
+
+def _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q, block_k,
+            offset):
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    if b_ref is not None:
+        s = s + b_ref[0, 0].astype(jnp.float32)
+    if causal:
+        s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
+                offset):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _body():
+        s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
+                    block_k, offset)
+        m_prev = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0, 0] = jnp.broadcast_to(
+                m_ref[:, 0:1] + jnp.log(l_safe), lse_ref.shape[2:]
+            )
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, offset):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _body():
+        s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
+                    block_k, offset)
+        p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+        dp = jax.lax.dot_general(
+            do_ref[0, 0], v_ref[0, 0],
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, 0:1]) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0, 0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, offset):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _body():
+        s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
+                    block_k, offset)
+        p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+        do = do_ref[0, 0]
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0],
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, 0:1]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0, 0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bias_spec(bias, block_q, block_k, kv_major=False):
+    """BlockSpec for an additive bias of shape (B|1, H|1, sq, sk), broadcasting
+    over size-1 batch/head dims via the index map."""
+    if bias is None:
+        return None
+    bb = bias.shape[0] > 1
+    bh = bias.shape[1] > 1
+
+    if kv_major:
+        def imap(b, h, ki, qi):
+            return (b if bb else 0, h if bh else 0, qi, ki)
+    else:
+        def imap(b, h, qi, ki):
+            return (b if bb else 0, h if bh else 0, qi, ki)
+
+    return pl.BlockSpec((1, 1, block_q, block_k), imap)
+
+
+def _wrap_nobias(kernel, bias_pos):
+    """Adapt a kernel expecting a bias ref at ``bias_pos`` to the no-bias call
+    signature by injecting ``None``."""
+
+    def wrapped(*refs):
+        refs = list(refs)
+        refs.insert(bias_pos, None)
+        return kernel(*refs)
+
+    return wrapped
+
+
+def _check_shapes(q, k, v, bias):
+    b, h, sq, d = q.shape
+    bk, hk, sk, dk = k.shape
+    assert v.shape == k.shape, (v.shape, k.shape)
+    assert (bk, hk, dk) == (b, h, d), (q.shape, k.shape)
+    if bias is not None:
+        assert bias.ndim == 4 and bias.shape[2:] == (sq, sk), bias.shape
+        assert bias.shape[0] in (1, b) and bias.shape[1] in (1, h), bias.shape
+    return b, h, sq, sk, d
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    # primal path (inference / no grad): skip the logsumexp output entirely
+    return _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
+                           interpret, need_stats=False)
+
+
+def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
+                    need_stats=True):
+    b, h, sq, sk, d = _check_shapes(q, k, v, bias)
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+
+    def qmap(bb, hh, qi, ki):
+        return (bb, hh, qi, 0)
+
+    def kmap(bb, hh, qi, ki):
+        return (bb, hh, ki, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), qmap),
+        pl.BlockSpec((1, 1, block_k, d), kmap),
+        pl.BlockSpec((1, 1, block_k, d), kmap),
+        _bias_spec(bias, block_q, block_k),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset,
+    )
+    if bias is None:
+        kernel = _wrap_nobias(kernel, 3)
+    if need_stats:
+        out_specs = [
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_q, LANES), qmap),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+        ]
+    else:
+        # inject lse_ref=None: kernel args are (q, k, v, bias, o, <lse>, ...)
+        kernel = _wrap_nobias(kernel, 5 if bias is not None else 4)
+        out_specs = pl.BlockSpec((1, 1, block_q, d), qmap)
+        out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    result = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[s for s in in_specs if s is not None],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * b * h * sq * sk * d * (0.5 if causal else 1.0)),
+            bytes_accessed=int(2 * (q.size + k.size + v.size + q.size)),
+            transcendentals=int(b * h * sq * sk),
+        ),
+    )(*[x for x in (q, k, v, bias) if x is not None])
+    return result
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
+                               interpret, need_stats=True)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, bias, out, lse = res
+    b, h, sq, sk, d = _check_shapes(q, k, v, bias)
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+
+    delta = jnp.broadcast_to(
+        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (b, h, sq, LANES),
+    )
+
+    def qmap(bb, hh, qi, ki):
+        return (bb, hh, qi, 0)
+
+    def kmap(bb, hh, qi, ki):
+        return (bb, hh, ki, 0)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset,
+    )
+    if bias is None:
+        dq_kernel = _wrap_nobias(dq_kernel, 3)
+    dq_specs = [
+        pl.BlockSpec((1, 1, block_q, d), qmap),        # q
+        pl.BlockSpec((1, 1, block_k, d), kmap),        # k
+        pl.BlockSpec((1, 1, block_k, d), kmap),        # v
+        _bias_spec(bias, block_q, block_k),            # bias
+        pl.BlockSpec((1, 1, block_q, d), qmap),        # do
+        pl.BlockSpec((1, 1, block_q, LANES), qmap),    # lse
+        pl.BlockSpec((1, 1, block_q, LANES), qmap),    # delta
+    ]
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[s for s in dq_specs if s is not None],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(*[x for x in (q, k, v, bias, g, lse, delta) if x is not None])
+
+    # dk/dv sweep: grid (b, h, k_block, q_block) so the per-k-block
+    # accumulators persist in scratch across the q sweep.
+    def kv_qmap(bb, hh, ki, qi):
+        return (bb, hh, qi, 0)
+
+    def kv_kmap(bb, hh, ki, qi):
+        return (bb, hh, ki, 0)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset,
+    )
+    if bias is None:
+        dkv_kernel = _wrap_nobias(dkv_kernel, 3)
+    dkv_specs = [
+        pl.BlockSpec((1, 1, block_q, d), kv_qmap),     # q
+        pl.BlockSpec((1, 1, block_k, d), kv_kmap),     # k
+        pl.BlockSpec((1, 1, block_k, d), kv_kmap),     # v
+        _bias_spec(bias, block_q, block_k, kv_major=True),
+        pl.BlockSpec((1, 1, block_q, d), kv_qmap),     # do
+        pl.BlockSpec((1, 1, block_q, LANES), kv_qmap),  # lse
+        pl.BlockSpec((1, 1, block_q, LANES), kv_qmap),  # delta
+    ]
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, nk, nq),
+        in_specs=[s for s in dkv_specs if s is not None],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), kv_kmap),
+            pl.BlockSpec((1, 1, block_k, d), kv_kmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*[x for x in (q, k, v, bias, g, lse, delta) if x is not None])
+
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supports(seq_q, seq_k, head_dim,
+             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Static shape gate: sequence lengths must tile into 128-aligned blocks
+    (head_dim is padded to a lane multiple automatically)."""
+    bq, bk = min(block_q, seq_q), min(block_k, seq_k)
+    return (
+        seq_q % bq == 0 and seq_k % bk == 0
+        and bq % LANES == 0 and bk % LANES == 0
+    )
+
+
+def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=None):
+    """Blockwise flash attention.
+
+    Args:
+      q, k, v: ``(batch, seq, heads, head_dim)`` (paddle layout).
+      bias: optional additive mask (bool masks are converted), shape
+        ``(sq, sk)`` or ``(B|1, H|1, sq, sk)``.  The fused backward treats
+        the mask as a constant (zero gradient) — route trainable biases
+        through the einsum path instead.
+      causal: bottom-right-aligned causal mask (row r attends keys
+        ``<= r + sk - sq``, matching softmax-attention convention).
+      scale: softmax scale; default ``1/sqrt(head_dim)``.
+
+    Returns ``(batch, seq_q, heads, head_dim)``.
+    """
+    from . import interpret_requested
+
+    if interpret is None:
+        interpret = interpret_requested()
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if not supports(sq, sk, d, block_q, block_k):
+        raise ValueError(
+            f"flash_attention needs 128-aligned sequence blocks: seq_q={sq}, "
+            f"seq_k={sk}, block_q={block_q}, block_k={block_k}"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d_pad = -d % LANES
+    if d_pad:
+        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias.ndim not in (2, 4):
+            raise ValueError(
+                f"flash_attention mask must be (sq, sk) or (B|1, H|1, sq, sk); "
+                f"got shape {bias.shape} — a 3-D mask is ambiguous"
+            )
+        if bias.dtype == jnp.bool_:
+            bias = jnp.where(bias, 0.0, NEG_INF).astype(jnp.float32)
+        else:
+            bias = bias.astype(jnp.float32)
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+    out = _flash(qt, kt, vt, bias, float(scale), bool(causal),
+                 int(block_q), int(block_k), bool(interpret))
+    if d_pad:
+        out = out[..., :d]
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,213 @@
+"""Pallas kernel parity tests (interpreter mode on the CPU mesh).
+
+Mirrors the reference's fused-op tests (e.g.
+``unittests/test_fused_attention_op.py``): the fused kernel must match the
+naive composition in both forward values and gradients.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import pallas
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+from paddle_tpu.ops.pallas.layer_norm import fused_layer_norm
+
+
+def _ref_attention(q, k, v, bias=None, causal=False):
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cmask, logits, -1e30)
+    if bias is not None:
+        logits = logits + bias
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _rand_qkv(b=2, s=256, h=2, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.3
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_parity(causal):
+    q, k, v = _rand_qkv()
+    with pallas.interpret_mode():
+        out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = _ref_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_forward_bias():
+    q, k, v = _rand_qkv()
+    rng = np.random.RandomState(1)
+    bias = jnp.asarray(rng.randn(1, 1, 256, 256).astype(np.float32))
+    with pallas.interpret_mode():
+        out = flash_attention(q, k, v, bias=bias, block_q=128, block_k=128)
+    ref = _ref_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_forward_bool_padding_mask():
+    q, k, v = _rand_qkv()
+    keep = np.ones((1, 1, 256, 256), bool)
+    keep[..., 200:] = False  # mask out trailing keys
+    with pallas.interpret_mode():
+        out = flash_attention(q, k, v, bias=jnp.asarray(keep),
+                              block_q=128, block_k=128)
+    ref = _ref_attention(q, k, v, bias=jnp.where(jnp.asarray(keep), 0.0, -1e30))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_parity(causal):
+    q, k, v = _rand_qkv(s=128)
+
+    def loss_flash(q, k, v):
+        with pallas.interpret_mode():
+            out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+        return jnp.sum(out * jnp.cos(out))
+
+    def loss_ref(q, k, v):
+        out = _ref_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gr), atol=5e-5, rtol=5e-4,
+            err_msg=f"d{name} mismatch (causal={causal})",
+        )
+
+
+def test_flash_multi_kblock_grad():
+    # sequence spanning several k blocks exercises the scratch accumulators
+    q, k, v = _rand_qkv(s=512)
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v)
+            return jnp.sum(out**2)
+        return f
+
+    with pallas.interpret_mode():
+        gf = jax.grad(
+            loss(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=128)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    gr = jax.grad(
+        loss(lambda q, k, v: _ref_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-4)
+
+
+def test_flash_causal_cross_length():
+    """sq != sk: causal alignment must match the einsum path's bottom-right
+    convention (tril with k = sk - sq)."""
+    rng = np.random.RandomState(3)
+    b, h, d = 2, 2, 64
+    q = jnp.asarray(rng.randn(b, 128, h, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, 256, h, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, 256, h, d).astype(np.float32)) * 0.3
+    with pallas.interpret_mode():
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    ref = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_sdpa_broadcast_padding_mask_routes_to_einsum():
+    """(b,1,1,sk) key-padding masks can't stream through the flash kernel;
+    routing must fall back to the broadcasting einsum path, not crash."""
+    import paddle_tpu  # noqa: F401
+    from paddle_tpu.framework.tensor import Tensor
+    import paddle_tpu.nn.functional as F
+
+    q, k, v = _rand_qkv(s=128)
+    mask = np.zeros((2, 1, 1, 128), np.float32)
+    mask[..., 100:] = -1e30
+    with pallas.interpret_mode():
+        out = F.scaled_dot_product_attention(
+            Tensor(q), Tensor(k), Tensor(v), attn_mask=Tensor(mask)
+        )
+    ref = _ref_attention(q, k, v, bias=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fused_layer_norm_parity():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(4, 37, 256).astype(np.float32))
+    gamma = jnp.asarray(rng.randn(256).astype(np.float32))
+    beta = jnp.asarray(rng.randn(256).astype(np.float32))
+
+    def ref(x, gamma, beta):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * gamma + beta
+
+    with pallas.interpret_mode():
+        out = fused_layer_norm(x, gamma, beta, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(x, gamma, beta)),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_fused(x, gamma, beta):
+        with pallas.interpret_mode():
+            return jnp.sum(fused_layer_norm(x, gamma, beta, eps=1e-5) ** 2)
+
+    def loss_ref(x, gamma, beta):
+        return jnp.sum(ref(x, gamma, beta) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ["dx", "dgamma", "dbeta"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-4, err_msg=name)
+
+
+def test_sdpa_routes_to_flash_under_interpret():
+    """F.scaled_dot_product_attention picks the Pallas path when available."""
+    import paddle_tpu  # noqa: F401  (registers ops)
+    from paddle_tpu.framework.tensor import Tensor
+    import paddle_tpu.nn.functional as F
+
+    q, k, v = _rand_qkv(s=128)
+    with pallas.interpret_mode():
+        out = F.scaled_dot_product_attention(
+            Tensor(q), Tensor(k), Tensor(v), is_causal=True
+        )
+    ref = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sdpa_dropout_actually_drops():
+    """dropout_p must change the output in training (was a silent no-op)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    q, k, v = _rand_qkv(s=64)  # small seq -> einsum path
+    out_nodrop = F.scaled_dot_product_attention(
+        Tensor(q), Tensor(k), Tensor(v), dropout_p=0.0, training=True
+    )
+    out_drop = F.scaled_dot_product_attention(
+        Tensor(q), Tensor(k), Tensor(v), dropout_p=0.5, training=True
+    )
+    diff = np.abs(np.asarray(out_drop._value) - np.asarray(out_nodrop._value)).max()
+    assert diff > 1e-3, "attention dropout had no effect"
+    # eval mode: dropout disabled
+    out_eval = F.scaled_dot_product_attention(
+        Tensor(q), Tensor(k), Tensor(v), dropout_p=0.5, training=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_eval._value), np.asarray(out_nodrop._value), atol=1e-6
+    )
