@@ -13,13 +13,13 @@ from ..ops import dispatch
 # in low precision; numerically-sensitive ops stay fp32.
 white_list = {
     "matmul", "conv_nd", "conv_transpose_nd", "linear", "bmm", "mv", "einsum",
-    "addmm", "dot", "inner", "outer", "sdpa", "bilinear_op",
+    "addmm", "dot", "inner", "outer", "sdpa", "flash_sdpa", "bilinear_op",
 }
 black_list = {
     "exp", "log", "log2", "log10", "log1p", "logsumexp", "pow", "elementwise_pow",
     "square", "rsqrt", "softmax_op", "log_softmax_op", "softmax_ce", "weighted_nll",
     "soft_nll", "nll_loss_op", "bce_op", "bce_logits_op", "kl_div_op",
-    "layer_norm_op", "batch_norm_train", "batch_norm_infer", "group_norm_op",
+    "layer_norm_op", "fused_layer_norm", "batch_norm_train", "batch_norm_infer", "group_norm_op",
     "instance_norm_op", "mean", "sum", "cumsum", "norm_op", "dist", "cosine_similarity_op",
     "sigmoid_focal_op", "ctc_op", "rms_norm",
 }
@@ -83,15 +83,46 @@ amp_guard = auto_cast
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
-    """paddle.amp.decorate — O2 casts model params to the low-precision dtype
-    (reference amp/auto_cast.py decorate:81). On TPU bf16 master weights are
-    generally unnecessary; master_weight=True keeps an fp32 copy inside the
-    optimizer accumulators (they are fp32 already)."""
+    """paddle.amp.decorate (reference amp/auto_cast.py decorate:81).
+
+    O2 casts model params to the low-precision dtype, keeping normalization
+    layers fp32 (reference ``keep_batch_norm_fp32``).  ``master_weight``
+    (default on for O2) turns on the optimizers' multi-precision path: fp32
+    master weights + fp32 moments, params rounded from the master each step
+    (reference adam multi-precision op)."""
     single = not isinstance(models, (list, tuple))
     model_list = [models] if single else list(models)
+    norm_types = (
+        "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+        "SyncBatchNorm", "LayerNorm", "InstanceNorm1D", "InstanceNorm2D",
+        "InstanceNorm3D", "GroupNorm",
+    )
     if level == "O2":
+        lowp = dtypes.convert_dtype(dtype)
         for m in model_list:
-            m.to(dtype=dtype)
+            # cast per-sublayer, skipping norm layers entirely: casting them
+            # down and back would permanently round their fp32 state
+            for sub in m.sublayers(include_self=True):
+                if type(sub).__name__ in norm_types:
+                    sub._dtype = jnp.float32
+                    continue
+                for p in sub._parameters.values():
+                    if p is not None and dtypes.is_floating(p.dtype):
+                        p._value = p._value.astype(lowp)
+                for b in sub._buffers.values():
+                    if b is not None and dtypes.is_floating(b.dtype):
+                        b._value = b._value.astype(lowp)
+                sub._dtype = lowp
     if optimizers is None:
         return models if single else model_list
-    return (models if single else model_list), optimizers
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if master_weight is None:
+        master_weight = level == "O2"
+    if master_weight:
+        for opt in opt_list:
+            opt._multi_precision = True
+    return (
+        (models if single else model_list),
+        (optimizers if single_opt else opt_list),
+    )

@@ -100,6 +100,9 @@ class AmpScaler:
         pre_accs = {
             name: dict(store) for name, store in optimizer._accumulators.items()
         }
+        # checkpoint-restored values still waiting in _pending_state are the
+        # true pre-step values of accumulators materialized during this step
+        pre_pending = dict(getattr(optimizer, "_pending_state", {}))
         optimizer.step()
         for p, old in zip(params, pre_params):
             p._value = jnp.where(found, old, p._value)
@@ -109,9 +112,21 @@ class AmpScaler:
                 old = pre_store.get(key)
                 if old is None:
                     # accumulator born during this step — its pre-step value
-                    # is its recorded init fill
+                    # is the checkpoint-restored pending entry if one existed,
+                    # else its recorded init fill (a master weight's init is
+                    # the param itself)
                     fill, shape, dtype = optimizer._acc_meta[(name, key)]
-                    old = jnp.full(shape, fill, dtype)
+                    pend = pre_pending.get(f"{key}_{name}")
+                    if pend is not None:
+                        old = jnp.asarray(pend, dtype)
+                    elif name == "master_weight":
+                        pre = next(
+                            pv for p, pv in zip(params, pre_params)
+                            if optimizer._pkey(p) == key
+                        )
+                        old = pre.astype(dtype)
+                    else:
+                        old = jnp.full(shape, fill, dtype)
                 store[key] = jnp.where(found, old, new)
 
     def update(self):

@@ -12,15 +12,19 @@ from ...ops.dispatch import op
 
 @op("layer_norm_op")
 def _layer_norm_raw(x, weight=None, bias=None, epsilon=1e-5, begin_axis=-1, has_w=False, has_b=False):
+    # fp32 statistics and x.dtype output regardless of path or weight dtype
+    # (matches the fused Pallas kernel and the reference CUDA layer_norm,
+    # which computes in fp32 and writes back the input dtype)
+    xf = x.astype(jnp.float32)
     axes = tuple(range(begin_axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-    out = (x - mean) * jax_rsqrt(var + epsilon)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax_rsqrt(var + epsilon)
     if has_w:
-        out = out * weight
+        out = out * weight.astype(jnp.float32)
     if has_b:
-        out = out + bias
-    return out
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
 
 
 def jax_rsqrt(v):

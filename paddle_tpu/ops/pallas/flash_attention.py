@@ -12,9 +12,11 @@ Backward is two fused kernels (dq swept over k-blocks; dk/dv swept over
 q-blocks) recomputing p from the saved logsumexp — the FlashAttention-2
 recurrence.
 
-Row statistics (logsumexp, delta) are stored lane-broadcast as
-``(b, h, s, 128)`` so every in-kernel operand is a natively-tileable 2-D
-block; head_dim is zero-padded to a lane multiple in the wrapper.
+The logsumexp is stored sublane-oriented as ``(b, h, s, 8)`` (trailing dim
+equal to the full array dim keeps the block legal for Mosaic while staying
+16x smaller than a 128-lane broadcast); delta (= rowsum(do*o)) is never
+materialized — the backward kernels recompute it per tile from the streamed
+``o`` block.  head_dim is used unpadded (block dim = full array dim).
 
 Layout: public API takes paddle layout ``(batch, seq, heads, head_dim)``.
 """
@@ -31,6 +33,7 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 LANES = 128
+STAT_LANES = 8  # sublane-oriented row-stat arrays
 NEG_INF = -1e30
 
 
@@ -102,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
             )
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
                    dq_ref, dq_acc, *, scale, causal, block_q, block_k, offset):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
@@ -118,11 +121,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
         s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
                     block_k, offset)
         p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+        do = do_ref[0, 0]
+        # delta = rowsum(do * o): recomputed per tile from the streamed o
+        # block — elementwise O(block_q*d), far cheaper than materializing a
+        # lane-broadcast (b,h,sq,128) delta array in HBM
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
         dp = jax.lax.dot_general(
-            do_ref[0, 0], v_ref[0, 0],
+            do, v_ref[0, 0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, 0:1]) * scale
+        ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0, 0],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -133,7 +144,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
                     block_q, block_k, offset):
     ki, qi = pl.program_id(2), pl.program_id(3)
@@ -152,6 +163,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
                     block_k, offset)
         p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
         do = do_ref[0, 0]
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
             p.astype(do.dtype), do,
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -160,7 +175,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, delta_ref,
             do, v_ref[0, 0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, 0:1]) * scale
+        ds = p * (dp - delta) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0, 0],
             (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -247,11 +262,12 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
     if need_stats:
         out_specs = [
             pl.BlockSpec((1, 1, block_q, d), qmap),
-            pl.BlockSpec((1, 1, block_q, LANES), qmap),
+            pl.BlockSpec((1, 1, block_q, STAT_LANES),
+                         lambda bb, hh, qi, ki: (bb, hh, qi, 0)),
         ]
         out_shape = [
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, STAT_LANES), jnp.float32),
         ]
     else:
         # inject lse_ref=None: kernel args are (q, k, v, bias, o, <lse>, ...)
@@ -291,12 +307,6 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
 
-    delta = jnp.broadcast_to(
-        jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
-                keepdims=True),
-        (b, h, sq, LANES),
-    )
-
     def qmap(bb, hh, qi, ki):
         return (bb, hh, qi, 0)
 
@@ -315,8 +325,9 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
         pl.BlockSpec((1, 1, block_k, d), kmap),        # v
         _bias_spec(bias, block_q, block_k),            # bias
         pl.BlockSpec((1, 1, block_q, d), qmap),        # do
-        pl.BlockSpec((1, 1, block_q, LANES), qmap),    # lse
-        pl.BlockSpec((1, 1, block_q, LANES), qmap),    # delta
+        pl.BlockSpec((1, 1, block_q, d), qmap),        # o
+        pl.BlockSpec((1, 1, block_q, STAT_LANES),
+                     lambda bb, hh, qi, ki: (bb, hh, qi, 0)),  # lse
     ]
     dq = pl.pallas_call(
         dq_kernel,
@@ -326,7 +337,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias, g, lse, delta) if x is not None])
+    )(*[x for x in (q, k, v, bias, g, out, lse) if x is not None])
 
     # dk/dv sweep: grid (b, h, k_block, q_block) so the per-k-block
     # accumulators persist in scratch across the q sweep.
@@ -348,8 +359,9 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
         pl.BlockSpec((1, 1, block_k, d), kv_kmap),     # v
         _bias_spec(bias, block_q, block_k, kv_major=True),
         pl.BlockSpec((1, 1, block_q, d), kv_qmap),     # do
-        pl.BlockSpec((1, 1, block_q, LANES), kv_qmap),  # lse
-        pl.BlockSpec((1, 1, block_q, LANES), kv_qmap),  # delta
+        pl.BlockSpec((1, 1, block_q, d), kv_qmap),     # o
+        pl.BlockSpec((1, 1, block_q, STAT_LANES),
+                     lambda bb, hh, ki, qi: (bb, hh, qi, 0)),  # lse
     ]
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -368,7 +380,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias, g, lse, delta) if x is not None])
+    )(*[x for x in (q, k, v, bias, g, out, lse) if x is not None])
 
     dbias = None if bias is None else jnp.zeros_like(bias)
     return dq, dk, dv, dbias
@@ -377,15 +389,24 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def supports(seq_q, seq_k, head_dim,
+def _pick_block(seq, pref):
+    """Largest lane-aligned block <= pref that divides seq (0 if none)."""
+    b = min(pref, seq)
+    b -= b % LANES
+    while b >= LANES:
+        if seq % b == 0:
+            return b
+        b -= LANES
+    return 0
+
+
+def supports(seq_q, seq_k, head_dim=None,
              block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Static shape gate: sequence lengths must tile into 128-aligned blocks
-    (head_dim is padded to a lane multiple automatically)."""
-    bq, bk = min(block_q, seq_q), min(block_k, seq_k)
-    return (
-        seq_q % bq == 0 and seq_k % bk == 0
-        and bq % LANES == 0 and bk % LANES == 0
-    )
+    """Static shape gate: sequence lengths must tile into 128-aligned blocks.
+    ``head_dim`` is accepted for signature stability but unconstrained — the
+    kernels use it unpadded (block dim equals the full array dim, which
+    Mosaic accepts for any size)."""
+    return _pick_block(seq_q, block_q) > 0 and _pick_block(seq_k, block_k) > 0
 
 
 def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
@@ -411,23 +432,21 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
         interpret = interpret_requested()
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if not supports(sq, sk, d, block_q, block_k):
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    if not (block_q and block_k):
         raise ValueError(
             f"flash_attention needs 128-aligned sequence blocks: seq_q={sq}, "
-            f"seq_k={sk}, block_q={block_q}, block_k={block_k}"
+            f"seq_k={sk}"
         )
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
+    # head_dim needs no padding: the kernels' block last dim equals the full
+    # array dim, which Mosaic accepts for any d (lanes padded only in VMEM)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    d_pad = -d % LANES
-    if d_pad:
-        pad = [(0, 0), (0, 0), (0, 0), (0, d_pad)]
-        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
     if bias is not None:
         bias = jnp.asarray(bias)
         if bias.ndim not in (2, 4):
@@ -442,6 +461,4 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
     out = _flash(qt, kt, vt, bias, float(scale), bool(causal),
                  int(block_q), int(block_k), bool(interpret))
-    if d_pad:
-        out = out[..., :d]
     return jnp.swapaxes(out, 1, 2)

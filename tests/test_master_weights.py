@@ -1,0 +1,200 @@
+"""fp32 master weights / multi-precision optimizer tests.
+
+Reference: adam op multi-precision path
+(``paddle/fluid/operators/optimizers/adam_op.h`` MasterParam in/out) and
+``python/paddle/amp/auto_cast.py decorate:81`` master_weight semantics.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+
+
+def _bf16_model():
+    # fresh name scope: twin models must produce identical state_dict keys
+    # (mimics cross-process save/restore, reference unique_name.guard)
+    from paddle_tpu.utils import unique_name
+
+    with unique_name.guard():
+        paddle.seed(0)
+        m = nn.Linear(16, 16)
+    m.to(dtype="bfloat16")
+    return m
+
+
+def test_moments_and_master_are_fp32_under_bf16():
+    m = _bf16_model()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters(), multi_precision=True
+    )
+    x = Tensor(np.random.RandomState(0).randn(4, 16).astype(np.float32)).astype("bfloat16")
+    loss = m(x).pow(2).mean()
+    loss.backward()
+    opt.step()
+    for store_name in ("moment1", "moment2", "master_weight"):
+        store = opt._accumulators[store_name]
+        assert store, f"{store_name} empty"
+        for v in store.values():
+            assert v.dtype == jnp.float32, f"{store_name} is {v.dtype}"
+    for p in m.parameters():
+        assert p._value.dtype == jnp.bfloat16
+
+
+def test_master_weights_accumulate_small_updates():
+    """bf16 has ~8 bits of mantissa: a 1e-3 relative update vanishes without a
+    master copy but must accumulate with one."""
+    paddle.seed(0)
+
+    def run(multi_precision):
+        p = paddle.framework.tensor.Parameter(jnp.full((128,), 256.0, jnp.bfloat16))
+        p.name = f"p_mp{multi_precision}"
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, parameters=[p], multi_precision=multi_precision
+        )
+        for _ in range(64):
+            p.grad = jnp.full((128,), 1e-3, jnp.float32)  # update << bf16 ulp(256)=2
+            opt.step()
+            opt.clear_grad()
+        master = opt._accumulators.get("master_weight")
+        return np.asarray(p._value, np.float32)[0], master
+
+    final_plain, _ = run(False)
+    final_master, master_store = run(True)
+    # without master weights each 1e-3 step rounds away entirely
+    assert final_plain == 256.0
+    # with master weights 64 * 1e-3 accumulates in fp32 (param itself still
+    # rounds to the nearest bf16, but the master must carry the sum)
+    mv = float(np.asarray(next(iter(master_store.values()))[0]))
+    np.testing.assert_allclose(mv, 256.0 - 0.064, rtol=1e-5)
+
+
+def test_decorate_enables_master_and_keeps_ln_fp32():
+    paddle.seed(0)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+            self.ln = nn.LayerNorm(8)
+
+        def forward(self, x):
+            return self.ln(self.fc(x))
+
+    net = Net()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    assert opt._multi_precision is True
+    assert net.fc.weight._value.dtype == jnp.bfloat16
+    assert net.ln.weight._value.dtype == jnp.float32
+
+
+def test_master_weight_state_dict_roundtrip():
+    m = _bf16_model()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters(), multi_precision=True
+    )
+    x = Tensor(np.random.RandomState(0).randn(4, 16).astype(np.float32)).astype("bfloat16")
+    for _ in range(3):
+        loss = m(x).pow(2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    msd = m.state_dict()
+
+    m2 = _bf16_model()
+    m2.set_state_dict(msd)
+    opt2 = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m2.parameters(), multi_precision=True
+    )
+    opt2.set_state_dict(sd)
+    loss = m2(x).pow(2).mean()
+    loss.backward()
+    opt2.step()  # consumes pending master_weight instead of re-init
+
+    loss = m(x).pow(2).mean()
+    loss.backward()
+    opt.step()
+
+    for (k1, v1), (k2, v2) in zip(
+        sorted(opt._accumulators["master_weight"].items()),
+        sorted(opt2._accumulators["master_weight"].items()),
+    ):
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+
+
+def test_scaler_inf_skip_after_restore_keeps_checkpoint_state():
+    """First scaled step after set_state_dict overflows: the inf-skip must
+    restore the CHECKPOINT accumulator values (still pending, materialized
+    lazily during that very step), not the init fills."""
+    m = _bf16_model()
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m.parameters(), multi_precision=True
+    )
+    x = Tensor(np.random.RandomState(0).randn(4, 16).astype(np.float32)).astype("bfloat16")
+    for _ in range(3):
+        loss = m(x).pow(2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    msd = m.state_dict()
+    ckpt_m1 = {k: np.asarray(v) for k, v in opt._accumulators["moment1"].items()}
+    ckpt_mw = {k: np.asarray(v) for k, v in opt._accumulators["master_weight"].items()}
+
+    m2 = _bf16_model()
+    m2.set_state_dict(msd)
+    opt2 = paddle.optimizer.AdamW(
+        learning_rate=1e-2, parameters=m2.parameters(), multi_precision=True
+    )
+    opt2.set_state_dict(sd)  # everything lands in _pending_state
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**15)
+
+    bad = Tensor(np.full((4, 16), 1e30, np.float32)).astype("bfloat16")
+    loss = m2(bad).pow(2).mean()  # overflow -> inf grads
+    scaler.scale(loss).backward()
+    scaler.step(opt2)
+    scaler.update()
+
+    for key, want in ckpt_m1.items():
+        np.testing.assert_allclose(
+            np.asarray(opt2._accumulators["moment1"][key]), want, rtol=1e-6,
+            err_msg=f"moment1[{key}] lost its checkpoint value on the inf step",
+        )
+    for key, want in ckpt_mw.items():
+        np.testing.assert_allclose(
+            np.asarray(opt2._accumulators["master_weight"][key]), want, rtol=1e-6,
+            err_msg=f"master_weight[{key}] lost its checkpoint value on the inf step",
+        )
+
+
+def test_scaler_inf_skip_preserves_master_weights():
+    """A scaled step that overflows must leave the master weights untouched,
+    including masters born during that very step."""
+    m = _bf16_model()
+    opt = paddle.optimizer.SGD(
+        learning_rate=1.0, parameters=m.parameters(), multi_precision=True
+    )
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**15)
+    pre = {p.name: np.asarray(p._value, np.float32).copy() for p in m.parameters()}
+
+    x = Tensor(np.full((2, 16), 1e30, np.float32)).astype("bfloat16")
+    loss = m(x).pow(2).mean()  # overflows bf16 -> inf grads
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+
+    for p in m.parameters():
+        np.testing.assert_array_equal(
+            np.asarray(p._value, np.float32), pre[p.name],
+            err_msg=f"param {p.name} changed on an inf step",
+        )
+    for key, mw in opt._accumulators.get("master_weight", {}).items():
+        np.testing.assert_allclose(
+            np.asarray(mw), pre[key], rtol=1e-3,
+            err_msg=f"master {key} diverged from param on an inf step",
+        )

@@ -172,6 +172,46 @@ def test_fused_layer_norm_parity():
                                    atol=2e-4, rtol=1e-4, err_msg=name)
 
 
+def test_flash_seq_384_uses_128_block():
+    """128-aligned lengths that aren't multiples of the preferred 256 block
+    must still take the flash path (block falls back to 128)."""
+    from paddle_tpu.ops.pallas.flash_attention import supports
+
+    assert supports(384, 384, 64)
+    q, k, v = _rand_qkv(s=384, seed=7)
+    with pallas.interpret_mode():
+        out = flash_attention(q, k, v, causal=True)
+    ref = _ref_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fused_layer_norm_multiblock_grads():
+    """rows > BLOCK_ROWS exercises the cross-block dgamma/dbeta accumulation
+    (init-at-block-0 + revisited output block)."""
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(700, 128).astype(np.float32))  # 3 row blocks
+    gamma = jnp.asarray(rng.randn(128).astype(np.float32))
+    beta = jnp.asarray(rng.randn(128).astype(np.float32))
+
+    def ref(x, gamma, beta):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * gamma + beta
+
+    def loss_fused(x, gamma, beta):
+        with pallas.interpret_mode():
+            return jnp.sum(fused_layer_norm(x, gamma, beta, eps=1e-5) ** 2)
+
+    def loss_ref(x, gamma, beta):
+        return jnp.sum(ref(x, gamma, beta) ** 2)
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b, name in zip(gf, gr, ["dx", "dgamma", "dbeta"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-4, err_msg=name)
+
+
 def test_sdpa_routes_to_flash_under_interpret():
     """F.scaled_dot_product_attention picks the Pallas path when available."""
     import paddle_tpu  # noqa: F401  (registers ops)
