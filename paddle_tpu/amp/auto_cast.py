@@ -94,8 +94,9 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weigh
     model_list = [models] if single else list(models)
     norm_types = (
         "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
-        "SyncBatchNorm", "LayerNorm", "InstanceNorm1D", "InstanceNorm2D",
-        "InstanceNorm3D", "GroupNorm",
+        "SyncBatchNorm", "LayerNorm", "RMSNorm", "InstanceNorm1D",
+        "InstanceNorm2D", "InstanceNorm3D", "GroupNorm", "LocalResponseNorm",
+        "SpectralNorm",
     )
     if level == "O2":
         lowp = dtypes.convert_dtype(dtype)

@@ -49,6 +49,17 @@ def _mp_group(group):
     return _default_group()
 
 
+def _replicate_activation(val, mesh):
+    """Reshard an activation to replicated (the c_concat / c_allreduce_sum
+    point). Under an ambient mesh (e.g. inside the pipeline schedule's
+    partially-manual region, where pp is a Manual axis) a bare PartitionSpec
+    must be used; otherwise constrain against the group's concrete mesh."""
+    am = getattr(jax.sharding, "get_abstract_mesh", lambda: None)()
+    if am is not None and not getattr(am, "empty", True):
+        return jax.lax.with_sharding_constraint(val, P())
+    return jax.lax.with_sharding_constraint(val, NamedSharding(mesh, P()))
+
+
 def _shard(p, group, spec):
     """Annotate a parameter with a mesh sharding (the TP 'split')."""
     p._value = jax.device_put(p._value, NamedSharding(group.mesh, spec))
@@ -99,9 +110,7 @@ class ColumnParallelLinear(Layer):
         y = F.linear(x, self.weight, self.bias)
         if self.gather_output:
             # reshard to replicated ≙ c_concat along out dim
-            y._value = jax.device_put(
-                y._value, NamedSharding(self.group.mesh, P())
-            )
+            y._value = _replicate_activation(y._value, self.group.mesh)
         return y
 
 
@@ -146,7 +155,7 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         y = F.linear(x, self.weight, None)
-        y._value = jax.device_put(y._value, NamedSharding(self.group.mesh, P()))
+        y._value = _replicate_activation(y._value, self.group.mesh)
         if self.bias is not None:
             y = y + self.bias
         return y
@@ -177,7 +186,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         y = F.embedding(x, self.weight)
-        y._value = jax.device_put(y._value, NamedSharding(self.group.mesh, P()))
+        y._value = _replicate_activation(y._value, self.group.mesh)
         return y
 
 

@@ -11,9 +11,9 @@ the *placement* (PipelineLayer) keeps each stage's compute on its own
 pp-slice of the mesh. Because eager dispatch is async, micro-batch k+1's
 stage-0 compute is enqueued while micro-batch k still runs later stages —
 the device-level overlap 1F1B hand-schedules falls out of the async runtime.
-A fully-jitted ppermute 1F1B (for multi-host perf) lives in
-``paddle_tpu.parallel.pipeline_schedule`` and is used by the jit train-step
-path.
+The fully-jitted ppermute 1F1B (for multi-host perf) lives in
+``paddle_tpu.distributed.meta_parallel.pipeline_schedule``
+(``PipelinedModel``) and is what the jit train-step path uses.
 """
 from __future__ import annotations
 

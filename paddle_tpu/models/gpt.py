@@ -11,9 +11,8 @@ TPU-native design:
     (weight-sharding annotations; XLA inserts the collectives).
   * PP: ``build_gpt_pipeline_descs`` expresses the same model as
     PipelineLayer descs with tied embeddings via SharedLayerDesc.
-  * SP (green-field, SURVEY §5): attention can route through ring attention
-    over the ``sep`` axis inside shard_map train steps
-    (``paddle_tpu.nn.functional.ring_attention``).
+  * PP: ``build_pipelined_gpt`` (meta_parallel.pipeline_schedule) runs the
+    decoder stack as a jitted SPMD 1F1B pipeline over the ``pp`` axis.
   * Long context: causal sdpa uses the Pallas flash-attention kernel when
     available (falls back to fused-einsum XLA).
 """
