@@ -75,21 +75,31 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401
+from . import static  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
-# paddle.disable_static/enable_static compat: we are always "dygraph" unless
-# tracing; these are no-ops kept for API parity.
 _static_mode = False
 
 
 def enable_static():
+    """Switch to declarative mode: framework ops touching static.Variables
+    record into the current Program (reference paddle.enable_static)."""
     global _static_mode
     _static_mode = True
+    from .ops import dispatch
+    from .static.program import _recorder
+
+    dispatch.STATIC_RECORDER = _recorder
 
 
 def disable_static(place=None):
     global _static_mode
     _static_mode = False
+    from .ops import dispatch
+    from .static import program as _prog
+
+    if not _prog._guard_stack:
+        dispatch.STATIC_RECORDER = None
 
 
 def in_dynamic_mode():

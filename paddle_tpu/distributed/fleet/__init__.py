@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from .base.distributed_strategy import DistributedStrategy
 from .base.fleet_base import Fleet, fleet
+from . import utils  # noqa: F401  (fleet.utils.recompute)
 
 # module-level singleton API (reference exposes `paddle.distributed.fleet.*`)
 init = fleet.init

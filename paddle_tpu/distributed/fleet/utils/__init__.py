@@ -1,0 +1,4 @@
+"""Fleet utilities (reference ``python/paddle/distributed/fleet/utils/``)."""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential"]

@@ -1,0 +1,113 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: ``fleet/utils/recompute.py`` — ``RecomputeFunction:207`` (a
+PyLayer that stashes inputs + RNG state, re-runs the forward inside
+backward) and the public ``recompute:350`` API.
+
+TPU-native redesign: recomputation is a *compiler annotation*, not a
+hand-written replay. The wrapped region is traced through ``jax.checkpoint``
+so XLA saves only the region's inputs and re-materializes intermediates
+during the backward pass. RNG preservation is automatic by construction:
+dropout keys are drawn from the host generator while TRACING the region, so
+they are constants of the traced computation and the recomputed forward
+replays the identical masks (the reference must save/restore CUDA RNG state
+by hand to get the same guarantee).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+from ....autograd import no_grad
+from ....framework.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ....ops.dispatch import apply_op
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+@contextmanager
+def _install(tensors, values):
+    old = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._value = o
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              params=None, **kwargs):
+    """Run ``function(*args, **kwargs)`` with activation recomputation.
+
+    Args:
+      function: a Layer or callable. For a plain callable that reads
+        parameters, pass them via ``params=`` so their gradients flow
+        (a Layer's parameters are collected automatically).
+      args: positional inputs; Tensors participate in autograd.
+      preserve_rng_state / use_reentrant: accepted for reference API
+        compatibility; RNG preservation is inherent here (see module doc).
+      params: extra Parameters read inside ``function``.
+    """
+    if params is None:
+        params = list(function.parameters()) if isinstance(function, Layer) else []
+    params = [p for p in params if p is not None]
+    n_params = len(params)
+
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    def fwd(*arrays):
+        pvals = arrays[:n_params]
+        avals = arrays[n_params:]
+
+        def region(pvals, avals):
+            call_args = list(args)
+            for i, pos in enumerate(tensor_pos):
+                call_args[pos] = Tensor(avals[i])
+            with _install(params, pvals), no_grad():
+                out = function(*call_args, **kwargs)
+                if isinstance(out, Tensor):
+                    return out._value
+                if isinstance(out, (tuple, list)):
+                    return tuple(
+                        o._value if isinstance(o, Tensor) else o for o in out
+                    )
+                return out
+
+        return jax.checkpoint(region)(list(pvals), list(avals))
+
+    op_args = list(params) + [args[i] for i in tensor_pos]
+    return apply_op("recompute", fwd, tuple(op_args), {})
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference ``fleet/utils/recompute.py recompute_sequential``: chunked
+    recomputation over a Sequential's sublayers.
+
+    ``ctx``: dict with optional ``segments`` (number of chunks, default 1).
+    """
+    segments = int((ctx or {}).get("segments", 1))
+    layers = list(functions)
+    if not layers:
+        return args[0] if len(args) == 1 else args
+    per = max(1, len(layers) // segments)
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        chunk = layers[i:i + per]
+
+        def chunk_fn(x, _chunk=chunk):
+            for l in _chunk:
+                x = l(x)
+            return x
+
+        cparams = []
+        for l in chunk:
+            if isinstance(l, Layer):
+                cparams.extend(l.parameters())
+        x = recompute(chunk_fn, x, params=cparams)
+        i += per
+    return x

@@ -110,8 +110,10 @@ class PipelinedModel(Layer):
         self._m = int(num_microbatches)
         self._remat = bool(remat)
 
-        # template stage (functional apply target) + stacked parameters
-        self._template = stages[0]
+        # template stage (functional apply target, NOT registered: its params
+        # are placeholders that would otherwise shadow the stacked ones in
+        # parameters()/state_dict()) + stacked parameters
+        object.__setattr__(self, "_template", stages[0])
         tmpl_named = list(stages[0].named_parameters())
         self._tmpl_params = [p for _, p in tmpl_named]
         self._stacked = []

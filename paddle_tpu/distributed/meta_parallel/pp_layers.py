@@ -187,6 +187,24 @@ class PipelineLayer(Layer):
     # -- forward -------------------------------------------------------------
     def forward(self, x, stage_range=None):
         cur_stage = None
+        pending = []  # consecutive plain layers awaiting a recompute chunk
+
+        def flush(x):
+            if not pending:
+                return x
+            chunk = list(pending)
+            pending.clear()
+            if self._recompute_interval > 0 and self.training:
+                from ..fleet.utils import recompute_sequential
+
+                # reference pp_layers.py: every `recompute_interval` layers
+                # form one recomputed segment
+                seg = max(1, len(chunk) // self._recompute_interval)
+                return recompute_sequential({"segments": seg}, chunk, x)
+            for l in chunk:
+                x = l(x)
+            return x
+
         for i, (l, ffunc) in enumerate(self.run_functions):
             s = self._stage_of[i]
             if stage_range is not None and not (stage_range[0] <= s < stage_range[1]):
@@ -197,6 +215,7 @@ class PipelineLayer(Layer):
                 and self._placement == "submesh"
                 and s != cur_stage
             ):
+                x = flush(x)
                 # activation hop to the next stage's devices ≙ send/recv_v2;
                 # an autograd op so the backward hop happens in reverse
                 sh = self._stage_sharding(s)
@@ -207,8 +226,9 @@ class PipelineLayer(Layer):
                         "pp_transfer", lambda v: jax.device_put(v, sh), (x,), {}
                     )
                 cur_stage = s
-            if ffunc is not None:
-                x = ffunc(l, x)
+            if ffunc is None and isinstance(l, Layer):
+                pending.append(l)
             else:
-                x = l(x)
-        return x
+                x = flush(x)
+                x = ffunc(l, x) if ffunc is not None else l(x)
+        return flush(x)

@@ -88,6 +88,27 @@ def save(layer, path, input_spec=None, **configs):
         pickle.dump({"n_inputs": len(examples)}, f)
 
 
+def save_traced(fn, input_specs, path):
+    """Export a plain traced function (no Layer state) as StableHLO — the
+    serialization primitive behind ``static.save_inference_model``."""
+
+    def pure(params, *inputs):
+        del params
+        return fn(*inputs)
+
+    jitted = jax.jit(pure)
+    exported = jax.export.export(jitted)({}, *input_specs)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    _psave({}, path + ".pdiparams")
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"n_inputs": len(input_specs)}, f)
+    return path
+
+
 class TranslatedLayer(Layer):
     """A loaded StableHLO program behaving like a Layer
     (reference ``fluid/dygraph/io.py TranslatedLayer``)."""

@@ -31,6 +31,12 @@ OP_REGISTRY = {}
 # O1 per-op dtype policy (reference imperative/amp_auto_cast.h AutoCastGuard)
 AMP_HOOK = None
 
+# set by paddle_tpu.static.program_guard: callable (name, fwd, args, kwargs)
+# that records an op node when any arg is a symbolic static.Variable and
+# returns the output Variable(s), or None to run eagerly (reference static
+# mode appends OpDescs to the current BlockDesc instead of executing)
+STATIC_RECORDER = None
+
 
 def _needs_grad(t: Tensor) -> bool:
     return (not t.stop_gradient) and dtypes.is_differentiable(t.dtype)
@@ -48,6 +54,10 @@ def apply_op(name, fwd, args, static_kwargs):
     ``args`` may mix Tensors, raw arrays and python scalars; only Tensor args
     participate in autograd.
     """
+    if STATIC_RECORDER is not None:
+        recorded = STATIC_RECORDER(name, fwd, args, static_kwargs)
+        if recorded is not None:
+            return recorded
     if AMP_HOOK is not None:
         fwd = AMP_HOOK(name, fwd)
     vals = []

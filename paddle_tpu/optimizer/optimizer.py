@@ -213,7 +213,24 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        """dygraph minimize = backward + step (reference optimizer.py:1167)."""
+        """dygraph: backward + step (reference optimizer.py:1167). Static:
+        registers this optimizer on the loss's Program so Executor.run
+        computes grads and applies the update inside the compiled replay
+        (reference _append_optimize_op:559 appending to the ProgramDesc)."""
+        from ..static.program import Variable as _StaticVariable
+
+        if isinstance(loss, _StaticVariable):
+            prog = loss.program
+            if self._parameter_list is None:
+                self._parameter_list = [
+                    p for p in prog.all_parameters() if not p.stop_gradient
+                ]
+            prog._optimizers.append((self, loss))
+            prog._version += 1
+            from ..static.backward import append_backward
+
+            pairs = append_backward(loss, parameter_list=self._parameter_list)
+            return None, pairs
         loss.backward()
         self.step()
         return None, None

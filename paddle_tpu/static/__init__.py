@@ -1,0 +1,37 @@
+"""paddle.static — the declarative (graph) programming surface.
+
+Reference: ``python/paddle/fluid/framework.py`` (Program/Variable/default
+programs), ``fluid/executor.py:621 Executor`` (``run:1104``),
+``fluid/backward.py append_backward``, ``fluid/compiler.py CompiledProgram``.
+
+TPU-native redesign: a Program is an **op tape**, not a protobuf graph.
+While a ``program_guard`` is active, every framework op that touches a
+symbolic ``Variable`` records a node (forward callable + arg refs + static
+attrs) instead of executing; shapes/dtypes come from ``jax.eval_shape``.
+``Executor.run`` replays the tape once inside ``jax.jit`` — parameters and
+optimizer state thread through exactly like the dygraph CompiledStep, and
+``append_backward`` / ``Optimizer.minimize`` lower to ``jax.grad`` over the
+replayed loss.  The "executor" is therefore a cached XLA executable per
+(program, feed/fetch signature) — InterpreterCore's instruction list is the
+compiled HLO schedule itself.
+"""
+from .program import (
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    in_static_build,
+)
+from .executor import Executor, CompiledProgram, global_scope
+from .backward import append_backward
+from .io import save_inference_model, load_inference_model
+from . import nn
+
+__all__ = [
+    "Program", "Variable", "data", "default_main_program",
+    "default_startup_program", "program_guard", "Executor",
+    "CompiledProgram", "append_backward", "save_inference_model",
+    "load_inference_model", "nn", "global_scope", "in_static_build",
+]

@@ -1,0 +1,61 @@
+"""Static autodiff (reference ``fluid/backward.py append_backward``).
+
+The reference walks the ProgramDesc, appending one grad-op per forward op.
+Here the whole tape is differentiated at once: ``jax.grad`` over the
+replayed loss with respect to every trainable Parameter — the grad "ops"
+are whatever XLA's backward pass fuses them into.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.tensor import Parameter, Tensor
+from .program import Variable
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Mark ``loss`` for differentiation; returns [(param, grad_var)] with
+    fetchable ``<param>@GRAD`` variables (reference backward.py:append_backward
+    returns the same pairing)."""
+    program = loss.program
+    params = parameter_list or [
+        p for p in program.all_parameters() if not p.stop_gradient
+    ]
+    pairs = []
+    for p in params:
+        gv = Variable(f"{p.name}@GRAD", list(p.shape), p._value.dtype,
+                      program=program)
+        program._grad_vars[p.name] = gv
+        pairs.append((p, gv))
+    program._loss_for_grad = loss
+    return pairs
+
+
+def _grad_env(program, feed_env):
+    """Compute {param.name@GRAD: array} for the program's registered loss
+    (from append_backward or Optimizer.minimize). Traced under the
+    Executor's jit."""
+    from .executor import _replay
+
+    loss_var = getattr(program, "_loss_for_grad", None)
+    if loss_var is None and program._optimizers:
+        loss_var = program._optimizers[0][1]
+    if loss_var is None:
+        return {}
+    params = [p for p in program.all_parameters() if not p.stop_gradient]
+    if not params:
+        return {}
+
+    def loss_of(param_vals):
+        old = [p._value for p in params]
+        for p, v in zip(params, param_vals):
+            p._value = v
+        try:
+            env = _replay(program, dict(feed_env))
+            return env[loss_var.name]
+        finally:
+            for p, v in zip(params, old):
+                p._value = v
+
+    grads = jax.grad(loss_of)([p._value for p in params])
+    return {f"{p.name}@GRAD": g for p, g in zip(params, grads)}

@@ -1,0 +1,56 @@
+"""Static-graph model export/import.
+
+Reference: ``python/paddle/static/io.py:435 save_inference_model`` /
+``load_inference_model`` (serialized ProgramDesc + persistables).  The
+TPU-native serialized form is the jit module's StableHLO export — the same
+artifact ``paddle_tpu.jit.save`` writes — so a static Program exports by
+replaying its tape into a traced function first.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .program import Program, Variable
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the program slice from ``feed_vars`` to ``fetch_vars``."""
+    from ..jit import save_load
+    from .program import default_main_program
+    from .executor import _replay
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    program = program or (feed_vars[0].program if feed_vars[0].program else default_main_program())
+
+    def fn(*feeds):
+        env = {v.name: f for v, f in zip(feed_vars, feeds)}
+        env = _replay(program, env)
+        outs = [env[v.name] for v in fetch_vars]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    specs = [
+        jax.ShapeDtypeStruct(v._value.shape, v._value.dtype) for v in feed_vars
+    ]
+    save_load.save_traced(fn, specs, path_prefix)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program_like, feed_names, fetch_names) matching the reference
+    tuple shape; ``program_like`` is directly callable on numpy arrays."""
+    import pickle
+
+    from ..jit import save_load
+
+    loaded = save_load.load(path_prefix)
+    try:
+        with open(path_prefix + ".pdmeta", "rb") as f:
+            n = int(pickle.load(f).get("n_inputs", 1))
+    except OSError:
+        n = 1
+    return loaded, [f"x{i}" for i in range(n)], ["out"]

@@ -1,0 +1,220 @@
+"""static.nn — graph-building layer functions + structured control flow.
+
+Reference: ``python/paddle/static/nn/`` (fc, control_flow cond/while_loop —
+C++ twins ``operators/controlflow/conditional_block_op`` and ``while_op``).
+cond/while_loop lower directly to ``lax.cond`` / ``lax.while_loop`` so they
+work BOTH eagerly (dygraph Tensors, inside to_static traces) and while
+recording a static Program.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+__all__ = ["fc", "cond", "while_loop", "switch_case"]
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    """Reference static.nn.fc: flatten trailing dims then affine."""
+    from ..nn.layer.common import Linear
+    import paddle_tpu.nn.functional as F
+    import numpy as np
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    if len(x.shape) > num_flatten_dims + 1:
+        x = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    y = layer(x)
+    if activation:
+        y = getattr(F, activation)(y)
+    return y
+
+
+def _wrap_branch(fn):
+    """Adapt a user branch fn over Tensors to raw arrays for lax."""
+
+    def run(operands):
+        t_ops = [Tensor(o) for o in operands]
+        out = fn(*t_ops) if t_ops else fn()
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    return run
+
+
+def np_value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _closure_tensors(*fns):
+    """Tensors (incl. static Variables and Layer parameters) captured in the
+    branch functions' closures — the reference discovers conditional-block
+    inputs the same way, by scanning the sub-block's referenced vars."""
+    from ..nn.layer.layers import Layer
+
+    seen, out = set(), []
+
+    def add(v):
+        if isinstance(v, Tensor) and id(v) not in seen:
+            seen.add(id(v))
+            out.append(v)
+        elif isinstance(v, Layer):
+            for q in v.parameters():
+                add(q)
+
+    for fn in fns:
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                add(cell.cell_contents)
+            except ValueError:
+                pass
+        for d in (getattr(fn, "__defaults__", None) or ()):
+            add(d)
+    return out
+
+
+@contextlib.contextmanager
+def _install(tensors, values):
+    old = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._value = o
+
+
+@contextlib.contextmanager
+def _no_record():
+    """Suspend static recording while a control-flow body is traced (its
+    inner ops execute on tracers inside the lowered lax region)."""
+    from ..ops import dispatch
+
+    prev = dispatch.STATIC_RECORDER
+    dispatch.STATIC_RECORDER = None
+    try:
+        yield
+    finally:
+        dispatch.STATIC_RECORDER = prev
+
+
+def _concrete_bool(pred):
+    """Python truth value of pred when it is NOT symbolic/traced, else None."""
+    from ..static.program import Variable
+    from ..framework.tensor import _is_tracer
+
+    if isinstance(pred, Variable):
+        return None
+    v = pred._value if isinstance(pred, Tensor) else pred
+    if _is_tracer(v):
+        return None
+    return bool(v)
+
+
+def cond(pred, true_fn, false_fn, operands=(), name=None):
+    """Conditional execution (reference ``conditional_block_op``).
+
+    Eager (concrete pred): python-branches like the reference dygraph cond —
+    only the taken branch runs, with full autograd through anything it
+    touches.  Traced/static pred: lowers to ``lax.cond``; gradients then
+    flow through ``operands`` (pass tensors explicitly — traced closures are
+    captured as constants)."""
+    operands = list(operands)
+    taken = _concrete_bool(pred)
+    if taken is not None:
+        fn = true_fn if taken else false_fn
+        return fn(*operands)
+
+    hidden = [
+        t for t in _closure_tensors(true_fn, false_fn)
+        if t is not pred and all(t is not o for o in operands)
+    ]
+    n_ops = len(operands)
+
+    def fwd(pred_v, *vals):
+        op_vals, hid_vals = vals[:n_ops], vals[n_ops:]
+        p = pred_v.reshape(()) if hasattr(pred_v, "reshape") else pred_v
+        with _no_record(), _install(hidden, hid_vals):
+            return lax.cond(
+                p, _wrap_branch(true_fn), _wrap_branch(false_fn), list(op_vals)
+            )
+
+    return apply_op("cond", fwd, tuple([pred] + operands + hidden), {})
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """``lax.while_loop`` over Tensor loop_vars (reference ``while_op``;
+    C++ ``operators/controlflow/while_op.cc``).
+
+    Note: like the reference's RNN/while grad story, gradients through a
+    while_loop require the body to be jax-differentiable; prefer
+    ``lax.scan``-style fixed-trip loops (``paddle_tpu.ops.scan``) for
+    training loops."""
+    loop_vars = list(loop_vars)
+
+    # eager concrete loop vars: python-loop with full autograd (reference
+    # dygraph while semantics)
+    first = cond_fn(*loop_vars)
+    taken = _concrete_bool(first) if isinstance(first, Tensor) else None
+    if taken is not None:
+        state = list(loop_vars)
+        keep = taken
+        while keep:
+            out = body_fn(*state)
+            state = list(out) if isinstance(out, (tuple, list)) else [out]
+            keep = bool(np_value(cond_fn(*state)))
+        return tuple(state) if len(state) > 1 else state[0]
+
+    hidden = [
+        t for t in _closure_tensors(cond_fn, body_fn)
+        if all(t is not v for v in loop_vars)
+    ]
+    n_loop = len(loop_vars)
+
+    def fwd(*vals):
+        lv, hid_vals = vals[:n_loop], vals[n_loop:]
+
+        def c(state):
+            out = cond_fn(*[Tensor(s) for s in state])
+            return out._value.reshape(()) if isinstance(out, Tensor) else out
+
+        def b(state):
+            out = body_fn(*[Tensor(s) for s in state])
+            out = out if isinstance(out, (tuple, list)) else [out]
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+
+        with _no_record(), _install(hidden, hid_vals):
+            return lax.while_loop(c, b, tuple(lv))
+
+    return apply_op("while_loop", fwd, tuple(loop_vars + hidden), {})
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """``lax.switch`` (reference static.nn.switch_case)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns.keys())
+        fns = [branch_fns[k] for k in keys]
+    else:
+        fns = list(branch_fns)
+    if default is not None:
+        fns.append(default)
+
+    hidden = _closure_tensors(*fns)
+
+    def fwd(idx, *hid_vals):
+        i = idx.reshape(()) if hasattr(idx, "reshape") else idx
+        import jax.numpy as jnp
+
+        i = jnp.clip(i, 0, len(fns) - 1)
+        with _no_record(), _install(hidden, hid_vals):
+            return lax.switch(i, [_wrap_branch(f) for f in fns], ())
+
+    return apply_op("switch_case", fwd, tuple([branch_index] + hidden), {})

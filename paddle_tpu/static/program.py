@@ -1,0 +1,188 @@
+"""Program / Variable — the op-tape graph representation.
+
+Reference: ``fluid/framework.py`` ``ProgramDesc``/``BlockDesc``/``OpDesc``
+(C++ twins ``framework/program_desc.h:32``, ``block_desc.h:40``,
+``op_desc.h:33``).  Here an op node is ``(name, fwd, arg refs, attrs)``;
+ref kinds are Variables (dataflow edges), Parameters (persistable state) and
+python constants — the same three roles VarDesc distinguishes.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+
+import jax
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.tensor import Parameter, Tensor
+
+_name_counter = itertools.count()
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (reference fluid Variable).
+
+    ``_value`` is a ``jax.ShapeDtypeStruct`` so shape/dtype/ndim introspection
+    behaves like a real Tensor; any attempt to read data raises.
+    """
+
+    def __init__(self, name, shape, dtype, program=None, stop_gradient=True):
+        struct = jax.ShapeDtypeStruct(
+            tuple(1 if s in (None, -1) else int(s) for s in shape),
+            dtypes.convert_dtype(dtype),
+        )
+        # field-by-field init: Tensor.__init__ would jnp.asarray the struct
+        self._value = struct
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._out_slot = 0
+        self._hooks = []
+        self.persistable = False
+        self.is_leaf_param = False
+        self.name = name or f"var_{next(_name_counter)}"
+        self._declared_shape = list(shape)
+        self.program = program
+
+    @property
+    def shape(self):
+        return list(self._declared_shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} is symbolic; run it through "
+            "static.Executor to get values"
+        )
+
+    def __repr__(self):
+        return f"Variable(name={self.name!r}, shape={self.shape}, dtype={self._value.dtype})"
+
+
+class _OpNode:
+    __slots__ = ("op_name", "fwd", "args", "kwargs", "outs")
+
+    def __init__(self, op_name, fwd, args, kwargs, outs):
+        self.op_name = op_name
+        self.fwd = fwd
+        self.args = args      # mix of Variable / Tensor(Parameter) / consts
+        self.kwargs = kwargs  # static attrs
+        self.outs = outs      # list[Variable]
+
+
+class Program:
+    """An op tape + its placeholders, parameters and registered optimizers."""
+
+    def __init__(self):
+        self.ops: list[_OpNode] = []
+        self.placeholders: dict[str, Variable] = {}
+        self._optimizers = []   # [(optimizer, loss Variable)]
+        self._grad_vars = {}    # param name -> Variable for param@GRAD
+        self.random_seed = None
+        self._version = 0
+
+    # reference Program API shims
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        seen, out = set(), []
+        for node in self.ops:
+            for a in node.args:
+                if isinstance(a, Parameter) and id(a) not in seen:
+                    seen.add(id(a))
+                    out.append(a)
+        return out
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.ops = list(self.ops)
+        p.placeholders = dict(self.placeholders)
+        if not for_test:
+            p._optimizers = list(self._optimizers)
+            p._grad_vars = dict(self._grad_vars)
+        return p
+
+    def list_vars(self):
+        out = list(self.placeholders.values())
+        for node in self.ops:
+            out.extend(node.outs)
+        return out
+
+    # -- recording -----------------------------------------------------------
+    def _record(self, op_name, fwd, args, kwargs):
+        self._version += 1
+        specs = []
+        for a in args:
+            if isinstance(a, Variable):
+                specs.append(a._value)
+            elif isinstance(a, Tensor):
+                specs.append(jax.ShapeDtypeStruct(a._value.shape, a._value.dtype))
+            else:
+                specs.append(a)
+
+        def shaped(*sp):
+            return fwd(*sp, **kwargs)
+
+        out_struct = jax.eval_shape(shaped, *specs)
+        multi = isinstance(out_struct, (tuple, list))
+        structs = list(out_struct) if multi else [out_struct]
+        outs = [
+            Variable(f"{op_name}_{next(_name_counter)}.out{i}",
+                     list(s.shape), s.dtype, program=self)
+            for i, s in enumerate(structs)
+        ]
+        self.ops.append(_OpNode(op_name, fwd, list(args), dict(kwargs), outs))
+        return tuple(outs) if multi else outs[0]
+
+
+# -- default programs / guard -------------------------------------------------
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack = []
+
+
+def default_main_program():
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program():
+    return _guard_stack[-1][1] if _guard_stack else _default_startup
+
+
+def in_static_build() -> bool:
+    """True while a program_guard is recording (or global static mode with
+    the default programs)."""
+    import paddle_tpu
+
+    return bool(_guard_stack) or paddle_tpu._static_mode
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    from ..ops import dispatch
+
+    _guard_stack.append((main_program, startup_program or Program()))
+    prev = dispatch.STATIC_RECORDER
+    dispatch.STATIC_RECORDER = _recorder
+    try:
+        yield
+    finally:
+        _guard_stack.pop()
+        dispatch.STATIC_RECORDER = prev
+
+
+def _recorder(op_name, fwd, args, kwargs):
+    """dispatch hook: record iff any arg is symbolic."""
+    if not any(isinstance(a, Variable) for a in args):
+        return None
+    return default_main_program()._record(op_name, fwd, args, kwargs)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed placeholder (reference ``paddle.static.data``)."""
+    prog = default_main_program()
+    v = Variable(name, shape, dtype, program=prog)
+    prog.placeholders[name] = v
+    return v

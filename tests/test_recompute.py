@@ -1,0 +1,142 @@
+"""Activation-recomputation tests.
+
+Reference: ``fleet/utils/recompute.py`` (RecomputeFunction:207, recompute:350)
+and its unit tests (``unittests/test_dygraph_recompute.py``): outputs and
+gradients must match the non-recomputed run, RNG state must be preserved,
+and the backward must actually save less memory.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet.utils import recompute, recompute_sequential
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.utils import unique_name
+
+
+def _mlp(depth=4, width=64):
+    with unique_name.guard():
+        paddle.seed(0)
+        layers = []
+        for _ in range(depth):
+            layers += [nn.Linear(width, width), nn.GELU()]
+        return nn.Sequential(*layers)
+
+
+def test_recompute_output_and_grad_parity():
+    m1, m2 = _mlp(), _mlp()
+    x_np = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+
+    x1 = Tensor(x_np)
+    out1 = m1(x1).pow(2).mean()
+    out1.backward()
+
+    x2 = Tensor(x_np)
+    out2 = recompute(m2, x2).pow(2).mean()
+    out2.backward()
+
+    np.testing.assert_allclose(
+        np.asarray(out1._value), np.asarray(out2._value), rtol=1e-6
+    )
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        assert p2.grad is not None, "recompute dropped a parameter gradient"
+        np.testing.assert_allclose(
+            np.asarray(p1.grad), np.asarray(p2.grad), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_recompute_preserves_dropout_rng():
+    """The recomputed forward must replay the same dropout mask (reference
+    preserve_rng_state=True)."""
+    with unique_name.guard():
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(32, 32), nn.Dropout(0.5), nn.Linear(32, 32))
+    x = Tensor(np.random.RandomState(1).randn(4, 32).astype(np.float32))
+
+    paddle.seed(123)
+    out = recompute(m, x).sum()
+    out.backward()
+    g1 = {p.name: np.asarray(p.grad).copy() for p in m.parameters()}
+    for p in m.parameters():
+        p.clear_grad()
+
+    paddle.seed(123)
+    out2 = m(x).sum()
+    out2.backward()
+    np.testing.assert_allclose(
+        float(np.asarray(out._value)), float(np.asarray(out2._value)), rtol=1e-6
+    )
+    for p in m.parameters():
+        np.testing.assert_allclose(np.asarray(p.grad), g1[p.name], rtol=1e-5)
+
+
+def test_recompute_sequential_chunks():
+    m = _mlp(depth=6)
+    x_np = np.random.RandomState(0).randn(4, 64).astype(np.float32)
+    ref = m(Tensor(x_np))
+    out = recompute_sequential({"segments": 3}, list(m), Tensor(x_np))
+    np.testing.assert_allclose(
+        np.asarray(out._value), np.asarray(ref._value), rtol=1e-6
+    )
+
+
+def test_recompute_recomputes_forward_in_backward():
+    """The compiled program must actually re-run the forward matmuls inside
+    the backward (that is what frees the activations on TPU).  XLA:CPU's
+    ``memory_analysis().temp_size_in_bytes`` is insensitive to remat (its
+    buffer accounting CSEs across the barrier), so the assertion is on the
+    optimized-HLO structure: the recompute build contains one extra forward
+    dot per layer."""
+    depth, width, batch = 8, 256, 256
+    m = _mlp(depth=depth, width=width)
+    x_np = np.random.RandomState(0).randn(batch, width).astype(np.float32)
+
+    def dot_count(use_recompute):
+        def train(x):
+            out = (recompute(m, x) if use_recompute else m(x)).pow(2).mean()
+            out.backward()
+            grads = [p.grad for p in m.parameters()]
+            for p in m.parameters():
+                p.clear_grad()
+            return grads
+
+        step = CompiledStep(train, stateful=[m], donate_state=False)
+        compiled = step.lower(Tensor(x_np)).compile()
+        return compiled.as_text().count(" dot(")
+
+    plain = dot_count(False)
+    remat = dot_count(True)
+    assert remat >= plain + depth - 1, (
+        f"recompute did not re-run forward matmuls in backward: "
+        f"{remat} vs {plain} (+{depth} layers)"
+    )
+
+
+def test_pipeline_layer_recompute_interval():
+    """PipelineLayer honors recompute_interval (was accepted-and-ignored)."""
+    from paddle_tpu.distributed.meta_parallel import PipelineLayer
+
+    with unique_name.guard():
+        paddle.seed(0)
+        descs = [nn.Linear(16, 16) for _ in range(4)]
+        pl_plain = PipelineLayer(descs, num_stages=1)
+    with unique_name.guard():
+        paddle.seed(0)
+        descs2 = [nn.Linear(16, 16) for _ in range(4)]
+        pl_rc = PipelineLayer(descs2, num_stages=1, recompute_interval=2)
+
+    x_np = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+    out_a = pl_plain(Tensor(x_np)).pow(2).mean()
+    out_a.backward()
+    out_b = pl_rc(Tensor(x_np)).pow(2).mean()
+    out_b.backward()
+    np.testing.assert_allclose(
+        np.asarray(out_a._value), np.asarray(out_b._value), rtol=1e-6
+    )
+    for pa, pb in zip(pl_plain.parameters(), pl_rc.parameters()):
+        np.testing.assert_allclose(
+            np.asarray(pa.grad), np.asarray(pb.grad), rtol=1e-5, atol=1e-6
+        )
