@@ -1,0 +1,3 @@
+"""paddle.incubate (reference ``python/paddle/incubate/``)."""
+from . import autograd  # noqa: F401
+from . import distributed  # noqa: F401

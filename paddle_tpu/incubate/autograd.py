@@ -1,0 +1,110 @@
+"""Higher-order autodiff (reference ``incubate/autograd/``:
+``primapi.py:22 forward_grad``, ``functional.py:172 Jacobian``, ``:262
+Hessian`` over the ``prim_ops`` primitive layer).
+
+TPU-native: jax already exposes composable forward/reverse transforms, so
+these are direct lowerings — no primitive-op rewrite layer needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["grad", "forward_grad", "jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _pure(func, n_inputs):
+    def f(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        return out._value if isinstance(out, Tensor) else out
+
+    return f
+
+
+def grad(func, xs, create_graph=False):
+    """Gradient of a scalar-valued ``func`` with support for higher-order
+    composition (``create_graph`` is implicit: the returned Tensors are
+    produced by ops, so they can be differentiated again)."""
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    from ..ops.dispatch import apply_op
+
+    f = _pure(func, len(xs))
+
+    def fwd(*arrays):
+        gs = jax.grad(f, argnums=tuple(range(len(arrays))))(*arrays)
+        return tuple(gs)
+
+    out = apply_op("incubate_grad", fwd, tuple(xs), {})
+    return out if len(xs) > 1 else out[0]
+
+
+def jvp(func, xs, v):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    v = v if isinstance(v, (list, tuple)) else [v]
+    f = _pure(func, len(xs))
+    y, tangent = jax.jvp(f, tuple(_unwrap(x) for x in xs),
+                         tuple(_unwrap(t) for t in v))
+    return Tensor(y), Tensor(tangent)
+
+
+forward_grad = jvp
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    f = _pure(func, len(xs))
+    y, pullback = jax.vjp(f, *[_unwrap(x) for x in xs])
+    if v is None:
+        v = jnp.ones_like(y)
+    else:
+        v = _unwrap(v)
+    gs = pullback(v)
+    gs = [Tensor(g) for g in gs]
+    return Tensor(y), (gs if len(gs) > 1 else gs[0])
+
+
+class Jacobian:
+    """reference functional.py:172 — lazy full Jacobian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+        f = _pure(func, len(xs_list))
+        jac = jax.jacobian(f, argnums=tuple(range(len(xs_list))))(
+            *[_unwrap(x) for x in xs_list]
+        )
+        self._jac = jac if len(xs_list) > 1 else (jac[0],)
+        self._single = len(xs_list) == 1
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[0][idx]) if self._single else Tensor(self._jac[idx[0]][idx[1:]])
+
+    @property
+    def shape(self):
+        return list(self._jac[0].shape)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self._jac[0])
+
+
+class Hessian(Jacobian):
+    """reference functional.py:262 — Hessian of a scalar func."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+        f = _pure(func, len(xs_list))
+
+        def scalar(*arrays):
+            out = f(*arrays)
+            return out.reshape(())
+
+        h = jax.hessian(scalar, argnums=0)(*[_unwrap(x) for x in xs_list])
+        self._jac = (h,)
+        self._single = True

@@ -1,0 +1,129 @@
+"""MoE gates (reference ``incubate/distributed/models/moe/gate/``:
+``naive_gate.py``, ``gshard_gate.py``, ``switch_gate.py``).
+
+Each gate maps token features -> (combine_weights, dispatch_mask, aux_loss)
+in the GShard dense-dispatch form:
+
+  combine_weights: [tokens, experts, capacity] float — weight for gathering
+  dispatch_mask:   [tokens, experts, capacity] bool  — token→slot routing
+  aux_loss:        scalar load-balance loss (0 for the naive gate)
+
+The cumsum position-assignment is branch-free and jit-friendly; tokens past
+an expert's capacity are dropped exactly like the reference's ``prune_gate``
+path (their combine weight is zero, so the residual passes through).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ......nn.layer.layers import Layer
+from ......nn.layer.common import Linear
+
+__all__ = ["NaiveGate", "SwitchGate", "GShardGate", "BaseGate"]
+
+
+def _positions(mask, offset=None):
+    """Slot index of each kept token within its expert (cumsum-1), plus an
+    optional per-expert base offset [experts]."""
+    pos = jnp.cumsum(mask, axis=0) - 1
+    if offset is not None:
+        pos = pos + offset[None, :]
+    return pos
+
+
+def _dispatch_onehot(mask, pos, capacity):
+    """[S, E] keep-mask + [S, E] positions -> [S, E, C] slot one-hot."""
+    keep = (mask > 0) & (pos < capacity)
+    slot = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity + 1,
+                          dtype=jnp.float32)[..., :capacity]
+    return slot * keep[..., None].astype(jnp.float32)
+
+
+def _load_balance_loss(probs, top1_mask, num_experts):
+    """GShard/Switch auxiliary loss: E * sum_e mean(probs_e) * mean(mask_e)."""
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(top1_mask.astype(jnp.float32), axis=0)
+    return num_experts * jnp.sum(me * ce)
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, capacity_factor=1.2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        self.linear = Linear(d_model, num_experts, bias_attr=False)
+
+    def capacity(self, num_tokens, k=1):
+        return max(1, int(self.capacity_factor * k * num_tokens / self.num_experts))
+
+    def logits(self, x):
+        return self.linear(x)
+
+
+class NaiveGate(BaseGate):
+    """reference naive_gate.py: plain top-k softmax routing, no aux loss."""
+
+    top_k = 1
+
+    def dispatch_fn(self, logits_v, capacity):
+        probs = jax.nn.softmax(logits_v, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)
+        mask = jax.nn.one_hot(top1, self.num_experts, dtype=jnp.float32)
+        pos = _positions(mask)
+        slot = _dispatch_onehot(mask, pos, capacity)
+        gate = jnp.sum(probs * mask, axis=-1)
+        combine = slot * gate[:, None, None]
+        return combine, slot > 0, jnp.zeros((), jnp.float32)
+
+
+class SwitchGate(BaseGate):
+    """reference switch_gate.py: top-1 routing + load-balance aux loss."""
+
+    top_k = 1
+
+    def dispatch_fn(self, logits_v, capacity):
+        probs = jax.nn.softmax(logits_v, axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)
+        mask = jax.nn.one_hot(top1, self.num_experts, dtype=jnp.float32)
+        aux = _load_balance_loss(probs, mask, self.num_experts)
+        pos = _positions(mask)
+        slot = _dispatch_onehot(mask, pos, capacity)
+        gate = jnp.sum(probs * mask, axis=-1)
+        combine = slot * gate[:, None, None]
+        return combine, slot > 0, aux
+
+
+class GShardGate(BaseGate):
+    """reference gshard_gate.py: top-2 routing, normalized gates, aux loss on
+    the top-1 assignment."""
+
+    top_k = 2
+
+    def dispatch_fn(self, logits_v, capacity):
+        probs = jax.nn.softmax(logits_v, axis=-1)
+        e = self.num_experts
+        top1 = jnp.argmax(probs, axis=-1)
+        mask1 = jax.nn.one_hot(top1, e, dtype=jnp.float32)
+        probs2 = probs * (1.0 - mask1)
+        top2 = jnp.argmax(probs2, axis=-1)
+        mask2 = jax.nn.one_hot(top2, e, dtype=jnp.float32)
+
+        aux = _load_balance_loss(probs, mask1, e)
+
+        pos1 = _positions(mask1)
+        # expert slots already taken by first choices
+        used1 = jnp.sum(mask1, axis=0)
+        pos2 = _positions(mask2, offset=used1)
+        slot1 = _dispatch_onehot(mask1, pos1, capacity)
+        slot2 = _dispatch_onehot(mask2, pos2, capacity)
+
+        g1 = jnp.sum(probs * mask1, axis=-1)
+        g2 = jnp.sum(probs * mask2, axis=-1)
+        denom = jnp.maximum(g1 + g2, 1e-9)
+        g1, g2 = g1 / denom, g2 / denom
+
+        combine = slot1 * g1[:, None, None] + slot2 * g2[:, None, None]
+        dispatch = (slot1 + slot2) > 0
+        return combine, dispatch, aux

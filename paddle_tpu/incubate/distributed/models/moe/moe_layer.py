@@ -1,0 +1,173 @@
+"""Mixture-of-experts layer with expert parallelism.
+
+Reference: ``incubate/distributed/models/moe/moe_layer.py:244 MoELayer`` —
+token dispatch via ``global_scatter``/``global_gather`` all-to-all CUDA ops
+(``operators/collective/global_scatter_op.cc``), experts bound per rank.
+
+TPU-native redesign (GShard dense dispatch): expert parameters are STACKED
+``[E, ...]`` and sharded over the MoE group's mesh axis; routing is a pair
+of einsums against the gate's dispatch/combine one-hots
+
+    dispatched = einsum('sec,sm->ecm', dispatch, tokens)
+    out        = einsum('sec,ecm->sm', combine,  expert_out)
+
+whose resharding (tokens: data-sharded -> expert-sharded and back) XLA's
+SPMD partitioner lowers to exactly the all_to_all pair the reference codes
+by hand — fused with the surrounding matmuls.  The expert computation runs
+as ``jax.vmap`` of a functional apply over the stacked weights, so experts
+can be arbitrary (identical-structure) Layers.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .....framework.tensor import Parameter, Tensor
+from .....nn.layer.layers import Layer
+from .....ops.dispatch import apply_op
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer"]
+
+
+@contextmanager
+def _install(tensors, values):
+    old = [t._value for t in tensors]
+    for t, v in zip(tensors, values):
+        t._value = v
+    try:
+        yield
+    finally:
+        for t, o in zip(tensors, old):
+            t._value = o
+
+
+def _make_gate(gate, d_model, num_experts):
+    if isinstance(gate, BaseGate):
+        return gate
+    cfg = dict(gate or {})
+    typ = cfg.pop("type", "gshard")
+    cls = {"gshard": GShardGate, "switch": SwitchGate, "naive": NaiveGate}[typ]
+    top_k = cfg.pop("top_k", None)
+    g = cls(d_model, num_experts, **cfg)
+    if top_k is not None:
+        g.top_k = int(top_k)
+    return g
+
+
+class MoELayer(Layer):
+    """``MoELayer(d_model, experts, gate={'type': 'gshard'}, moe_group=...)``
+
+    ``experts``: list of identical-structure Layers (one per expert).
+    ``moe_group``: collective Group whose mesh axis carries the experts
+    (defaults to the fleet data-parallel group when initialized; dense
+    single-device execution otherwise).  After ``forward`` the gate's
+    auxiliary load-balance loss is available as ``self.aux_loss`` (a Tensor
+    on the autograd graph — add it to the training loss, reference
+    ``gate.get_loss()``).
+    """
+
+    def __init__(self, d_model, experts, gate=None, moe_group=None,
+                 mp_group=None, recompute_interval=0, name=None,
+                 capacity_factor=None):
+        super().__init__()
+        experts = list(experts)
+        self.num_experts = len(experts)
+        self.d_model = d_model
+        self.gate = _make_gate(gate, d_model, self.num_experts)
+        if capacity_factor is not None:
+            self.gate.capacity_factor = float(capacity_factor)
+        self.moe_group = moe_group if moe_group is not None else self._default_group()
+        self.aux_loss = None
+
+        # stack expert params (template apply pattern, like the pipeline)
+        object.__setattr__(self, "_template", experts[0])
+        tmpl_named = list(experts[0].named_parameters())
+        self._tmpl_params = [p for _, p in tmpl_named]
+        self._stacked = []
+        mesh_axis = None
+        if self.moe_group is not None:
+            mesh_axis = (self.moe_group.mesh, self.moe_group.axis_name)
+        for name_, p0 in tmpl_named:
+            per = []
+            for ex in experts:
+                q = dict(ex.named_parameters())[name_]
+                if tuple(q.shape) != tuple(p0.shape):
+                    raise ValueError(
+                        f"expert param {name_} shape mismatch: {q.shape} vs {p0.shape}"
+                    )
+                per.append(q._value)
+            arr = jnp.stack(per)
+            if mesh_axis is not None:
+                mesh, axis = mesh_axis
+                arr = jax.device_put(arr, NamedSharding(mesh, P(axis)))
+            sp = Parameter(arr, trainable=not p0.stop_gradient)
+            sp.optimize_attr = dict(p0.optimize_attr)
+            self.add_parameter("experts__" + name_.replace(".", "__"), sp)
+            self._stacked.append(sp)
+
+    @staticmethod
+    def _default_group():
+        from .....distributed.fleet.base.fleet_base import (
+            get_hybrid_communicate_group,
+        )
+
+        hcg = get_hybrid_communicate_group()
+        if hcg is not None:
+            return hcg.get_data_parallel_group()
+        return None
+
+    def forward(self, x):
+        orig_shape = list(x.shape)
+        d = orig_shape[-1]
+        tokens = int(jnp.prod(jnp.asarray(orig_shape[:-1]))) if len(orig_shape) > 1 else 1
+        x2 = x.reshape([-1, d])
+        capacity = self.gate.capacity(tokens, k=self.gate.top_k)
+
+        gate_params = list(self.gate.parameters())
+        n_gate = len(gate_params)
+        n_stack = len(self._stacked)
+        template, tmpl_params = self._template, self._tmpl_params
+        gate_obj = self.gate
+        axis = self.moe_group.axis_name if self.moe_group is not None else None
+        mesh = self.moe_group.mesh if self.moe_group is not None else None
+
+        def fwd(*arrays):
+            gvals = arrays[:n_gate]
+            svals = list(arrays[n_gate:n_gate + n_stack])
+            xv = arrays[-1]
+
+            from .....autograd import no_grad
+
+            with _install(gate_params, gvals), no_grad():
+                logits = gate_obj.logits(Tensor(xv))._value
+            combine, dispatch, aux = gate_obj.dispatch_fn(
+                logits.astype(jnp.float32), capacity
+            )
+
+            dispatched = jnp.einsum(
+                "sec,sm->ecm", dispatch.astype(xv.dtype), xv
+            )
+            if mesh is not None:
+                dispatched = jax.lax.with_sharding_constraint(
+                    dispatched,
+                    NamedSharding(mesh, P(axis)),
+                )
+
+            def one_expert(leaves, toks):
+                with _install(tmpl_params, leaves), no_grad():
+                    return template(Tensor(toks))._value
+
+            expert_out = jax.vmap(one_expert)(svals, dispatched)
+            out = jnp.einsum(
+                "sec,ecm->sm", combine.astype(expert_out.dtype), expert_out
+            )
+            return out, aux
+
+        args = gate_params + self._stacked + [x2]
+        out, aux = apply_op("moe_layer", fwd, tuple(args), {})
+        self.aux_loss = aux
+        return out.reshape(orig_shape[:-1] + [out.shape[-1]])
