@@ -43,6 +43,7 @@ from .framework.place import (  # noqa: F401
     is_compiled_with_tpu,
 )
 from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
 from .framework.tensor import Parameter, Tensor, to_tensor, is_tensor  # noqa: F401
 
 # the whole tensor-op surface (also patches Tensor methods)
@@ -76,6 +77,10 @@ from . import io  # noqa: F401
 from . import metric  # noqa: F401
 from . import vision  # noqa: F401
 from . import static  # noqa: F401
+from . import profiler  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi.model_summary import summary  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 _static_mode = False

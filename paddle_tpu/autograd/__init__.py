@@ -8,6 +8,7 @@ from .engine import (  # noqa: F401
     set_grad_enabled,
     is_grad_enabled,
 )
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 
 
 def is_grad_enabled_fn():

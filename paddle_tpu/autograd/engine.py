@@ -93,18 +93,58 @@ class Edge:
         self.leaf = leaf      # leaf Tensor to accumulate .grad into (or None)
 
 
+def leaf_edge(t) -> Edge:
+    """Edge for an op/PyLayer input: to its producer node, or to the leaf."""
+    if t._grad_node is not None:
+        return Edge(node=t._grad_node, slot=t._out_slot)
+    return Edge(leaf=t)
+
+
 class GradNode:
     """One recorded op application (cf. ``egr::GradNodeBase``)."""
 
-    __slots__ = ("name", "vjp_fn", "edges", "out_info", "multi", "hooks", "__weakref__")
+    __slots__ = ("name", "vjp_fn", "edges", "out_info", "multi", "hooks",
+                 "fwd_closed", "inputs", "__weakref__")
 
-    def __init__(self, name, vjp_fn, edges, out_info, multi):
+    def __init__(self, name, vjp_fn, edges, out_info, multi,
+                 fwd_closed=None, inputs=None):
         self.name = name
         self.vjp_fn = vjp_fn          # cotangents -> tuple(input cotangents)
         self.edges = edges            # list[Edge], aligned with vjp inputs
         self.out_info = out_info      # list[(shape, dtype)] per output slot
         self.multi = multi            # forward returned a tuple
         self.hooks = {}               # out_slot -> [hook fns]
+        # For double backward (create_graph=True): the closed forward over the
+        # differentiable primals, and those primal Tensors (≙ the reference's
+        # TensorWrapper-saved inputs, eager/tensor_wrapper.h). The backward
+        # traversal re-expresses this node's vjp as a *recorded op* over
+        # (primals, cotangents), so grad-of-grad flows through both.
+        self.fwd_closed = fwd_closed
+        self.inputs = inputs
+
+    def run_vjp_recorded(self, cot_tensors):
+        """Execute this node's vjp as a recorded op (create_graph path)."""
+        import jax
+
+        from ..ops.dispatch import apply_op
+
+        if self.fwd_closed is None or self.inputs is None:
+            raise RuntimeError(
+                f"GradNode {self.name} does not support create_graph=True "
+                "(no saved forward)."
+            )
+        n_in = len(self.inputs)
+        multi = self.multi
+        fwd_closed = self.fwd_closed
+
+        def grad_fwd(*vals):
+            primals, cots = vals[:n_in], vals[n_in:]
+            _, vjp_fn = jax.vjp(fwd_closed, *primals)
+            return tuple(vjp_fn(tuple(cots) if multi else cots[0]))
+
+        out = apply_op("grad_" + self.name, grad_fwd,
+                       tuple(self.inputs) + tuple(cot_tensors), {})
+        return out if isinstance(out, tuple) else (out,)
 
     def __repr__(self):
         return f"<GradNode {self.name} outs={len(self.out_info)}>"
@@ -134,11 +174,17 @@ def _zeros(info):
     return jnp.zeros(shape, dtype)
 
 
-def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None):
+def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None,
+         create_graph=False):
     """Core traversal. root_pairs: list of (tensor, seed_cotangent).
 
     If grad_sinks is a dict {id(tensor): tensor}, gradients for those leaves are
     returned in a dict instead of (or in addition to) .grad accumulation.
+
+    With ``create_graph=True`` cotangents flow as *Tensors* and every vjp is
+    re-executed through the op dispatcher (``GradNode.run_vjp_recorded``), so
+    the produced gradients carry their own grad graph — the reference's
+    ``GeneralGrad``/double-backward (``eager/backward.cc:38``).
     """
     from ..framework.tensor import Tensor
 
@@ -178,13 +224,21 @@ def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None):
                 nodes[id(e.node)] = e.node
                 stack.append(e.node)
 
+    def zeros_for(info):
+        z = _zeros(info)
+        return Tensor(z, stop_gradient=True) if create_graph else z
+
     processed = 0
     while ready:
         node = ready.popleft()
         processed += 1
         buf = buffers.get(id(node), [None] * len(node.out_info))
+        # PyLayer ctx.set_materialize_grads(False): hand None through instead
+        # of zeros (reference py_layer semantics); builtin nodes always
+        # materialize (their vjp closures need arrays).
+        materialize = getattr(node, "materialize_grads", True)
         cots = [
-            b if b is not None else _zeros(info)
+            b if b is not None else (zeros_for(info) if materialize else None)
             for b, info in zip(buf, node.out_info)
         ]
         if node_sinks:
@@ -197,19 +251,37 @@ def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None):
         # per-slot gradient hooks (tensor.register_hook on intermediate tensors)
         for slot, hooks in node.hooks.items():
             for h in hooks:
-                r = h(Tensor(cots[slot], stop_gradient=True))
+                arg = cots[slot] if create_graph else Tensor(cots[slot], stop_gradient=True)
+                r = h(arg)
                 if r is not None:
-                    cots[slot] = r._value if isinstance(r, Tensor) else jnp.asarray(r)
-        if node.vjp_fn is None:
-            raise RuntimeError(
-                f"GradNode {node.name} was already released; call backward with "
-                "retain_graph=True to backprop through the same graph twice."
-            )
-        in_cots = node.vjp_fn(tuple(cots) if node.multi else cots[0])
-        if not retain_graph:
-            node.vjp_fn = None
+                    if create_graph:
+                        cots[slot] = r
+                    else:
+                        cots[slot] = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+        if create_graph:
+            in_cots = node.run_vjp_recorded(cots)
+        else:
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    f"GradNode {node.name} was already released; call backward with "
+                    "retain_graph=True to backprop through the same graph twice."
+                )
+            in_cots = node.vjp_fn(tuple(cots) if node.multi else cots[0])
+            if not retain_graph:
+                # release residuals AND the saved-for-double-backward primals
+                # (else forward activations stay alive through the node chain)
+                node.vjp_fn = None
+                node.fwd_closed = None
+                node.inputs = None
         buffers.pop(id(node), None)
         for e, c in zip(node.edges, in_cots):
+            if c is None:
+                # a PyLayer backward may return None for an input (no grad)
+                if e.node is not None:
+                    pending[id(e.node)] -= 1
+                    if pending[id(e.node)] == 0:
+                        ready.append(e.node)
+                continue
             if e.leaf is not None:
                 _deposit_leaf(e.leaf, c, accumulate, grad_sinks, sink_grads)
             elif e.node is not None:
@@ -224,10 +296,11 @@ def _run(root_pairs, retain_graph=False, accumulate=True, grad_sinks=None):
 def _deposit_leaf(t, cot, accumulate, grad_sinks, sink_grads):
     from ..framework.tensor import Tensor
 
+    is_t = isinstance(cot, Tensor)
     for h in t._hooks:
-        r = h(Tensor(cot, stop_gradient=True))
+        r = h(cot if is_t else Tensor(cot, stop_gradient=True))
         if r is not None:
-            cot = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+            cot = r if is_t else (r._value if isinstance(r, Tensor) else jnp.asarray(r))
     if grad_sinks is not None:
         # paddle.grad semantics: collect requested grads, never touch .grad.
         if id(t) in grad_sinks:
@@ -235,7 +308,7 @@ def _deposit_leaf(t, cot, accumulate, grad_sinks, sink_grads):
                 cot if id(t) not in sink_grads else sink_grads[id(t)] + cot
             )
         return
-    t._accumulate_grad(cot)
+    t._accumulate_grad(cot._value if is_t else cot)
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -269,16 +342,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """paddle.grad — functional gradient w.r.t. ``inputs`` without touching .grad.
 
     Reference: ``GeneralGrad`` in ``paddle/fluid/eager/backward.cc:38``.
-    create_graph (double backward) is not yet supported — the jit path covers
-    higher-order via jax.grad composition instead.
+    With ``create_graph=True`` the returned gradients carry their own grad
+    graph (vjps re-run through the recording dispatcher), so gradient
+    penalties and other higher-order dygraph losses differentiate through.
     """
     from ..framework.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.incubate.autograd (jax.grad "
-            "composition) for higher-order derivatives."
-        )
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -293,17 +362,29 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     sinks = {id(t): t for t in inputs}
     pairs = []
     for t, g in zip(outputs, grad_outputs):
-        seed = (
-            jnp.ones(t._value.shape, t._value.dtype)
-            if g is None
-            else (g._value if isinstance(g, Tensor) else jnp.asarray(g))
-        )
+        if create_graph:
+            seed = (
+                Tensor(jnp.ones(t._value.shape, t._value.dtype), stop_gradient=True)
+                if g is None
+                else (g if isinstance(g, Tensor) else Tensor(jnp.asarray(g), stop_gradient=True))
+            )
+        else:
+            seed = (
+                jnp.ones(t._value.shape, t._value.dtype)
+                if g is None
+                else (g._value if isinstance(g, Tensor) else jnp.asarray(g))
+            )
         pairs.append((t, seed))
-    sink_grads = _run(pairs, retain_graph=retain, accumulate=False, grad_sinks=sinks)
+    sink_grads = _run(pairs, retain_graph=retain, accumulate=False,
+                      grad_sinks=sinks, create_graph=create_graph)
     results = []
     for t in inputs:
         if id(t) in sink_grads:
-            results.append(Tensor(sink_grads[id(t)], stop_gradient=True))
+            got = sink_grads[id(t)]
+            if isinstance(got, Tensor):
+                results.append(got)
+            else:
+                results.append(Tensor(got, stop_gradient=True))
         elif allow_unused:
             results.append(None)
         else:

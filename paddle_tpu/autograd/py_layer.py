@@ -1,0 +1,161 @@
+"""PyLayer — user-defined dygraph autograd ops.
+
+Reference: ``python/paddle/autograd/py_layer.py:1`` (PyLayer/PyLayerContext,
+C++ side ``paddle/fluid/eager/custom_operator`` grad node). TPU-native
+redesign: a PyLayer application records a :class:`PyLayerNode` in the same
+tape the op dispatcher uses, whose vjp simply *calls the user's* ``backward``
+— eagerly (wrapped Tensors) on the raw path, or under grad recording when
+``create_graph=True`` so double backward composes through user ops.
+
+The user's forward/backward bodies are ordinary paddle_tpu ops, hence fully
+jax-traceable: a PyLayer inside a ``jit.functionalize`` step lowers into the
+same single XLA program (the reference's recompute is built on exactly this
+property, ``fleet/utils/recompute.py``).
+"""
+from __future__ import annotations
+
+from .engine import GradNode, is_grad_enabled, leaf_edge, no_grad
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    """Reference ``py_layer.py PyLayerContext``: carries state from forward
+    to backward (``save_for_backward``/``saved_tensor``)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace = False
+        self.non_differentiable = set()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace = True
+
+    def mark_non_differentiable(self, *tensors):
+        for t in tensors:
+            self.non_differentiable.add(id(t))
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerNode(GradNode):
+    __slots__ = ("cls", "ctx", "grad_pick")
+
+    def __init__(self, cls, ctx, edges, out_info, multi, grad_pick):
+        super().__init__(cls.__name__, None, edges, out_info, multi)
+        self.cls = cls
+        self.ctx = ctx
+        # which of the user-backward's outputs feed our edges (edges only
+        # cover the *differentiable* tensor inputs)
+        self.grad_pick = grad_pick
+        self.vjp_fn = self._raw_vjp
+
+    @property
+    def materialize_grads(self):
+        return self.ctx.materialize_grads
+
+    def _select(self, grads):
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        if len(grads) < (max(self.grad_pick) + 1 if self.grad_pick else 0):
+            raise ValueError(
+                f"{self.cls.__name__}.backward returned {len(grads)} gradients "
+                f"but the forward had {max(self.grad_pick) + 1}+ Tensor inputs."
+            )
+        return [grads[i] for i in self.grad_pick]
+
+    def _raw_vjp(self, cots):
+        from ..framework.tensor import Tensor
+
+        cot_list = list(cots) if self.multi else [cots]
+        tens = [None if c is None else Tensor(c, stop_gradient=True)
+                for c in cot_list]
+        with no_grad():
+            grads = self.cls.backward(self.ctx, *tens)
+        picked = self._select(grads)
+        return tuple(
+            None if g is None else (g._value if isinstance(g, Tensor) else g)
+            for g in picked
+        )
+
+    def run_vjp_recorded(self, cot_tensors):
+        # create_graph path: run the user backward with recording enabled so
+        # its ops append to the tape (double backward through PyLayer)
+        grads = self.cls.backward(self.ctx, *cot_tensors)
+        return tuple(self._select(grads))
+
+
+class PyLayer:
+    """Reference ``python/paddle/autograd/py_layer.py`` PyLayer.
+
+    Subclass with ``forward(ctx, *args)`` / ``backward(ctx, *grads)`` static
+    methods and call ``apply``::
+
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x, alpha):
+                ctx.save_for_backward(x)
+                ctx.alpha = alpha
+                return x * alpha
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * ctx.alpha
+
+        y = Scale.apply(x, 2.0)
+
+    ``backward`` must return one gradient per *Tensor* input of forward (None
+    allowed); non-Tensor inputs are skipped.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError("You must implement the forward function for PyLayer.")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError("You must implement the backward function for PyLayer.")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework import dtype as dtypes
+        from ..framework.tensor import Tensor
+
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)
+        ]
+        diff_inputs, grad_pick = [], []
+        for i, t in enumerate(tensor_inputs):
+            if (not t.stop_gradient) and dtypes.is_differentiable(t.dtype):
+                diff_inputs.append(t)
+                grad_pick.append(i)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        if not (is_grad_enabled() and diff_inputs):
+            return outputs
+
+        out_info = [(o._value.shape, o._value.dtype) for o in outs]
+        node = PyLayerNode(cls, ctx, [leaf_edge(t) for t in diff_inputs],
+                           out_info, multi, grad_pick)
+        wrapped = []
+        for slot, o in enumerate(outs):
+            nd = id(o) in ctx.non_differentiable
+            t = Tensor(o._value, stop_gradient=nd)
+            if not nd:
+                t._grad_node = node
+                t._out_slot = slot
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]

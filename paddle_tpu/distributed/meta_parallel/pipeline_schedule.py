@@ -26,10 +26,11 @@ TPU-native redesign — the whole pipeline is ONE jitted SPMD program:
   TPU pipelining layout (embedding/head matmuls batch over the whole batch
   instead of per micro-batch).
 
-RNG note: dropout keys inside the stage body are drawn once at trace time,
-so every tick reuses one mask pattern; train pipelined models with
-``hidden_dropout=0`` or treat dropout as an approximation here (the
-reference's RNGStatesTracker has the same per-rank-determinism caveat).
+RNG: the scan body is traced once, so dropout draws inside it route through
+``random.derive_scope(root_key, tick, stage)`` — the traced tick index and
+pipeline-stage index are folded into a per-step root key, giving every
+(tick, stage, draw-site) its own mask at runtime (reference analogue:
+``fleet/meta_parallel/parallel_layers/random.py`` RNGStatesTracker).
 """
 from __future__ import annotations
 
@@ -43,6 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ...autograd import no_grad
+from ...framework import random as rnd
 from ...framework.tensor import Parameter, Tensor
 from ...nn.layer.layers import Layer
 from ...ops.dispatch import apply_op
@@ -142,11 +144,25 @@ class PipelinedModel(Layer):
     def _stage_pure(self):
         template, tmpl_params = self._template, self._tmpl_params
 
-        def apply(leaves, x):
-            with _install(tmpl_params, leaves), no_grad():
+        def apply(leaves, x, rng_box):
+            # rng_box: (root_key, tick, stage) or None; dropout inside the
+            # stage derives per-(tick, stage) keys from it
+            with ExitStack() as es:
+                es.enter_context(_install(tmpl_params, leaves))
+                es.enter_context(no_grad())
+                if rng_box is not None:
+                    es.enter_context(rnd.derive_scope(*rng_box))
                 return template(Tensor(x))._value
 
         return jax.checkpoint(apply) if self._remat else apply
+
+    def train(self):
+        self._template.train()
+        return super().train()
+
+    def eval(self):
+        self._template.eval()
+        return super().eval()
 
     # -- the pipelined forward+loss as one autograd op -----------------------
     def forward(self, input_ids, labels=None):
@@ -158,6 +174,7 @@ class PipelinedModel(Layer):
         mesh, pp, M = self._mesh, self._pp, self._m
         stage_fn = self._stage_pure()
         with_loss = labels is not None
+        training = self.training
 
         def fwd(*arrays):
             pre_vals = arrays[:n_pre]
@@ -182,6 +199,10 @@ class PipelinedModel(Layer):
                 if batch % M:
                     raise ValueError(f"batch {batch} not divisible by {M} microbatches")
                 h_m = h.reshape((M, batch // M) + h.shape[1:])
+                # per-step root key for in-stage dropout; tick/stage indices
+                # are folded in inside the scan body (traced once, varies at
+                # runtime)
+                root = rnd.next_key() if training else None
 
                 if pp > 1:
                     def pipe(stacked_local, h_mb):
@@ -192,7 +213,8 @@ class PipelinedModel(Layer):
                         def tick(buf, t):
                             x0 = jnp.take(h_mb, jnp.clip(t, 0, M - 1), axis=0)
                             x_in = jnp.where(s == 0, x0, buf)
-                            y = stage_fn(local, x_in)
+                            y = stage_fn(local, x_in,
+                                         None if root is None else (root, t, s))
                             nxt = lax.ppermute(
                                 y, AXIS_PP,
                                 [(i, (i + 1) % pp) for i in range(pp)],
@@ -221,7 +243,9 @@ class PipelinedModel(Layer):
                 else:
                     sfn = stage_fn
                     outs = jnp.stack([
-                        sfn([a[0] for a in stack_vals], h_m[i]) for i in range(M)
+                        sfn([a[0] for a in stack_vals], h_m[i],
+                            None if root is None else (root, i, 0))
+                        for i in range(M)
                     ])
 
                 h_out = outs.reshape((batch,) + outs.shape[2:])
@@ -278,12 +302,6 @@ def build_pipelined_gpt(cfg, topology, num_microbatches=1, loss_fn=None,
     pp = topology.mesh.devices.shape[ax]
     if cfg.num_layers % pp:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by pp={pp}")
-    if cfg.hidden_dropout or cfg.attention_dropout:
-        raise ValueError(
-            "pipelined GPT requires hidden_dropout=0 and attention_dropout=0: "
-            "dropout keys inside the scanned stage body are drawn once at "
-            "trace time, so every tick/microbatch would reuse one mask"
-        )
     per = cfg.num_layers // pp
 
     pre = GPTEmbeddings(cfg)

@@ -35,6 +35,9 @@ _NAME_TO_DTYPE = {
     "int32": int32,
     "int64": int64,
     "uint8": uint8,
+    "uint16": np.dtype("uint16"),
+    "uint32": np.dtype("uint32"),
+    "uint64": np.dtype("uint64"),
     "bool": bool_,
     "complex64": complex64,
     "complex128": complex128,

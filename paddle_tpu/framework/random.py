@@ -12,16 +12,21 @@ RNGStatesTracker) is provided by ``paddle_tpu.distributed.fleet.rng_tracker``.
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["seed", "Generator", "default_generator", "next_key", "get_rng_state", "set_rng_state"]
+__all__ = ["seed", "Generator", "default_generator", "next_key",
+           "get_rng_state", "set_rng_state", "derive_scope"]
 
 
 class Generator:
     def __init__(self, seed_val: int = 0):
         self._key = jax.random.key(seed_val)
         self._seed = seed_val
+        self._derive_base = None   # set by derive_scope (scan-tick RNG)
+        self._derive_count = 0
 
     def manual_seed(self, seed_val: int):
         self._key = jax.random.key(int(seed_val))
@@ -29,7 +34,17 @@ class Generator:
         return self
 
     def next_key(self, num: int = 1):
-        """Split the state; returns one key (num=1) or an array of keys."""
+        """Split the state; returns one key (num=1) or an array of keys.
+
+        Inside a :func:`derive_scope` keys are derived by folding a running
+        counter into the scope's base key instead of advancing the global
+        state — this is how per-tick randomness works inside ``lax.scan``
+        bodies (the body is traced once; the base key carries the traced
+        tick index, the counter distinguishes draw sites)."""
+        if self._derive_base is not None:
+            k = jax.random.fold_in(self._derive_base, self._derive_count)
+            self._derive_count += 1
+            return k if num == 1 else jax.random.split(k, num)
         keys = jax.random.split(self._key, num + 1)
         self._key = keys[0]
         return keys[1] if num == 1 else keys[1:]
@@ -62,6 +77,25 @@ def seed(s: int):
 
 def next_key(num: int = 1):
     return default_generator.next_key(num)
+
+
+@contextlib.contextmanager
+def derive_scope(base, *data):
+    """Route ``next_key()`` draws to ``fold_in(base, *data)`` + a counter.
+
+    Used by scanned/pipelined schedules (reference analogue: the RNG trackers
+    of ``fleet/meta_parallel/parallel_layers/random.py``): ``data`` may be
+    traced ints (scan tick, pipeline-stage index), so the single traced body
+    yields different randomness per tick/stage at runtime."""
+    g = default_generator
+    for d in data:
+        base = jax.random.fold_in(base, d)
+    prev = (g._derive_base, g._derive_count)
+    g._derive_base, g._derive_count = base, 0
+    try:
+        yield
+    finally:
+        g._derive_base, g._derive_count = prev
 
 
 def get_rng_state():

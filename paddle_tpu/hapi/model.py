@@ -1,0 +1,341 @@
+"""hapi Model — the high-level train/eval/predict loop.
+
+Reference: ``python/paddle/hapi/model.py:915`` (``prepare:1499``,
+``fit:1574``, ``train_batch:1055``, Dynamic/Static adapters ``:704/:290``).
+
+TPU-native redesign: the reference switches between a DynamicGraphAdapter
+(eager op-by-op) and a StaticGraphAdapter (program build + Executor.run).
+Here there is one adapter: the dygraph-style train/eval functions are
+functionalized by ``jit.CompiledStep`` into cached XLA executables — the
+dygraph API *is* the static path on TPU. Metrics accumulate host-side
+between steps exactly like the reference's callbacks expect.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """Reference ``hapi/model.py:915``. ``Model(net)`` then
+    ``prepare(optimizer, loss, metrics)`` then ``fit/evaluate/predict``."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        if not isinstance(network, Layer):
+            raise TypeError("network must be a paddle Layer")
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+
+    # ------------------------------------------------------------------
+    # setup
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        """Reference ``model.py:1499``."""
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle Metric")
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        loss = self._loss(*(outs + labs))
+        if isinstance(loss, (list, tuple)):
+            from .. import ops
+
+            loss = ops.add_n([l.sum() for l in loss])
+        return loss.mean() if loss.ndim > 0 else loss
+
+    def _ensure_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        from ..jit.functionalize import CompiledStep
+
+        net, opt = self.network, self._optimizer
+
+        def step(*args):
+            n_in = step._n_inputs
+            ins, labs = args[:n_in], args[n_in:]
+            net.train()
+            outputs = net(*ins)
+            loss = self._compute_loss(outputs, list(labs))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            outs = _to_list(outputs)
+            return [loss] + outs
+
+        step._n_inputs = self._n_inputs_cached
+        self._train_step = CompiledStep(step, stateful=[net, opt],
+                                        donate_state=True)
+        return self._train_step
+
+    def _ensure_eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+        from ..jit.functionalize import CompiledStep
+
+        net = self.network
+
+        def step(*args):
+            n_in = step._n_inputs
+            ins, labs = args[:n_in], args[n_in:]
+            net.eval()
+            outputs = net(*ins)
+            loss = (self._compute_loss(outputs, list(labs))
+                    if self._loss is not None else None)
+            outs = _to_list(outputs)
+            return ([loss] + outs) if loss is not None else outs
+
+        step._n_inputs = self._n_inputs_cached
+        self._eval_step = CompiledStep(step, stateful=[net], donate_state=False)
+        return self._eval_step
+
+    def _ensure_pred_step(self):
+        if self._pred_step is not None:
+            return self._pred_step
+        from ..jit.functionalize import CompiledStep
+
+        net = self.network
+
+        def step(*ins):
+            net.eval()
+            return net(*ins)
+
+        self._pred_step = CompiledStep(step, stateful=[net], donate_state=False)
+        return self._pred_step
+
+    # ------------------------------------------------------------------
+    # batch-level API (reference model.py:1055/:1112/:1160)
+    # ------------------------------------------------------------------
+    def _split_batch(self, inputs, labels=None):
+        ins = [_to_tensor(t) for t in _to_list(inputs)]
+        labs = [_to_tensor(t) for t in _to_list(labels)]
+        # the compiled steps bake the input/label split point: rebuild them
+        # when the batch arity changes
+        arity = (len(ins), len(labs))
+        if getattr(self, "_step_arity", None) != arity:
+            self._step_arity = arity
+            self._train_step = None
+            self._eval_step = None
+            self._pred_step = None
+        self._n_inputs_cached = len(ins)
+        return ins, labs
+
+    def train_batch(self, inputs, labels=None, update=True):
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer, loss, ...) before training")
+        ins, labs = self._split_batch(inputs, labels)
+        res = self._ensure_train_step()(*(ins + labs))
+        loss, outs = res[0], res[1:]
+        self._update_metrics(outs, labs)
+        return [float(np.asarray(loss._value))]
+
+    def eval_batch(self, inputs, labels=None):
+        ins, labs = self._split_batch(inputs, labels)
+        res = self._ensure_eval_step()(*(ins + labs))
+        if self._loss is not None:
+            loss, outs = res[0], res[1:]
+        else:
+            loss, outs = None, _to_list(res)
+        self._update_metrics(outs, labs)
+        return [float(np.asarray(loss._value))] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        ins, _ = self._split_batch(inputs)
+        out = self._ensure_pred_step()(*ins)
+        return [np.asarray(o._value) for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        for m in self._metrics:
+            args = list(_to_list(outputs)) + list(labels)
+            state = m.compute(*args) if hasattr(m, "compute") else args
+            state = _to_list(state)
+            m.update(*[np.asarray(s._value) if isinstance(s, Tensor) else s
+                       for s in state])
+
+    # ------------------------------------------------------------------
+    # epoch loops (reference model.py:1574 fit / :1743 evaluate / :1852 predict)
+    # ------------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset) or hasattr(data, "__getitem__"):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=drop_last)
+        return data  # assume iterable of batches
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None, "train_data must be given!"
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last)
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=["loss"] + self._metrics_name())
+        self.stop_training = False
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(loader, cbks, "train")
+            if eval_loader is not None and epoch % eval_freq == 0:
+                cbks.on_begin("eval")
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_end("eval", eval_logs)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose,
+                                metrics=["loss"] + self._metrics_name())
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval")
+        cbks.on_end("eval", logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose)
+        cbks.on_begin("predict")
+        outputs = []
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            # labeled datasets: drop the trailing label column(s)
+            if self._loss is not None and len(batch) >= 2:
+                batch = batch[:-1]
+            cbks.on_batch_begin("predict", step)
+            outs = self.predict_batch(batch)
+            outputs.append(outs)
+            cbks.on_batch_end("predict", step, {"batch_size": len(batch[0])})
+        # transpose list-of-batches -> per-output list
+        by_output = list(zip(*outputs)) if outputs else []
+        if stack_outputs:
+            result = [np.concatenate(o, axis=0) for o in by_output]
+        else:
+            result = [list(o) for o in by_output]
+        cbks.on_end("predict", {})
+        return result
+
+    def _metrics_name(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, (list, tuple)) else [n])
+        return names
+
+    def _run_one_epoch(self, loader, cbks, mode):
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        total_samples = 0
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            # convention: trailing element(s) are labels when a loss is set
+            if self._loss is not None and len(batch) >= 2:
+                ins, labs = batch[:-1], batch[-1:]
+            else:
+                ins, labs = batch, []
+            cbks.on_batch_begin(mode, step, logs)
+            if mode == "train":
+                losses = self.train_batch(ins, labs)
+                logs["loss"] = losses[0]
+            else:
+                losses = self.eval_batch(ins, labs)
+                if losses:
+                    logs["loss"] = losses[0]
+            for m in self._metrics:
+                res = m.accumulate()
+                for name, v in zip(_to_list(m.name()), _to_list(res)):
+                    logs[name] = v
+            bs = ins[0].shape[0] if hasattr(ins[0], "shape") else len(ins[0])
+            total_samples += bs
+            cbks.on_batch_end(mode, step, logs)
+        if mode == "eval":
+            logs["eval_samples"] = total_samples
+        return dict(logs)
+
+    # ------------------------------------------------------------------
+    # persistence / introspection
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        """Reference ``model.py:1932``: <path>.pdparams (+ .pdopt)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        from ..framework.io import save as psave
+
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(path + ".pdopt")):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+        self._train_step = None
+        self._eval_step = None
+        self._pred_step = None
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)

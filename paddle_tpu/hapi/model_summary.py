@@ -1,0 +1,77 @@
+"""Model summary (reference ``python/paddle/hapi/model_summary.py``):
+layer table with output shapes + parameter counts via forward hooks."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Prints the per-layer table, returns
+    ``{'total_params': int, 'trainable_params': int}``."""
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or a sample input")
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        sizes = [s if isinstance(s, (tuple, list)) else (s,) for s in sizes]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else [dtypes] * len(sizes)
+        inputs = [
+            Tensor(np.zeros([d if d and d > 0 else 1 for d in s],
+                            np.dtype(dt or "float32")))
+            for s, dt in zip(sizes, dts)
+        ]
+    else:
+        inputs = input if isinstance(input, (list, tuple)) else [input]
+
+    rows = []
+    hooks = []
+
+    def register(layer, prefix):
+        for name, sub in layer._sub_layers.items():
+            path = f"{prefix}.{name}" if prefix else name
+            if sub._sub_layers:
+                register(sub, path)
+            else:
+                def hook(l, ins, out, path=path):
+                    shape = None
+                    o = out[0] if isinstance(out, (tuple, list)) else out
+                    if isinstance(o, Tensor):
+                        shape = list(o.shape)
+                    n_params = sum(
+                        int(np.prod(p.shape)) for p in l.parameters(include_sublayers=False)
+                    )
+                    rows.append((f"{type(l).__name__} ({path})", shape, n_params))
+
+                hooks.append(sub.register_forward_post_hook(hook))
+
+    register(net, "")
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+
+    width = 72
+    print("-" * width)
+    print(f"{'Layer (type)':<40}{'Output Shape':<20}{'Param #':>10}")
+    print("=" * width)
+    for name, shape, n in rows:
+        print(f"{name[:39]:<40}{str(shape):<20}{n:>10,}")
+    print("=" * width)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print("-" * width)
+    return {"total_params": total, "trainable_params": trainable}

@@ -17,8 +17,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ......nn.layer.layers import Layer
-from ......nn.layer.common import Linear
+from .....nn.layer.layers import Layer
+from .....nn.layer.common import Linear
 
 __all__ = ["NaiveGate", "SwitchGate", "GShardGate", "BaseGate"]
 

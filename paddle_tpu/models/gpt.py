@@ -192,10 +192,11 @@ class GPTForCausalLM(Layer):
         return ops.matmul(h, w, transpose_y=True)
 
     def loss(self, input_ids, labels):
-        logits = self(input_ids)
-        return F.cross_entropy(
-            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1, 1])
-        ).mean()
+        """Fused LM loss: head matmul + softmax-CE without materializing the
+        ``[tokens, vocab]`` logits (``ops.fused.fused_linear_cross_entropy``)."""
+        h = self.gpt(input_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return F.fused_linear_cross_entropy(h, w, labels)
 
 
 # ---------------------------------------------------------------------------

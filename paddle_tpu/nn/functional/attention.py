@@ -26,11 +26,21 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
     when a mask is given — a mask the kernel streams exactly: trailing dims
     ``(sq, sk)`` with broadcastable batch/head dims, and not a trainable bias
     (the fused backward does not produce a mask gradient)."""
+    from ...framework.flags import flag_value
     from ...ops import pallas
 
+    if flag_value("disable_flash_attention"):
+        return False
     if dropout_p > 0.0 and training:
         return False
     sq, sk = q_shape[1], k_shape[1]
+    # At short sequence lengths XLA's fused einsum attention beats the Pallas
+    # kernel on-chip (measured: GPT-2 s=1024 fwd 59 ms vs 75 ms) because the
+    # [sq, sk] logits fit HBM comfortably and d=64 half-fills the MXU
+    # contraction; the flash kernel pays off once the materialized logits
+    # (and their saved softmax residuals) stop fitting.
+    if sq * sk < flag_value("flash_attention_min_seq_prod") and not pallas.interpret_requested():
+        return False
     if mask is not None:
         if getattr(mask, "stop_gradient", True) is False:
             return False  # learned bias: einsum path computes its gradient

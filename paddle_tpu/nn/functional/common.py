@@ -34,6 +34,19 @@ def _dropout_masked(x, mask, scale=1.0):
     return x * mask * scale
 
 
+@op("dropout")
+def _dropout_static_raw(x, key_data, p=0.5, mshape=None, scale=1.0,
+                        seed_offset=0):
+    """Static-graph dropout: the mask is drawn INSIDE the op from the
+    per-run key the Executor threads through ``__rng_key__`` (folded with a
+    per-node offset), so every Executor.run draws fresh randomness — the
+    reference draws per-run curand states the same way.  Forward replay and
+    the backward's re-replay see the same env key, hence the same mask."""
+    key = jax.random.fold_in(jax.random.wrap_key_data(key_data), seed_offset)
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(mshape))
+    return x * keep.astype(x.dtype) * scale
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     """reference nn/functional/common.py dropout; mask drawn from the global
     generator so it is reproducible and traceable."""
@@ -53,9 +66,18 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         mshape = [shape[i] if i in [a % len(shape) for a in axes] else 1 for i in range(len(shape))]
     else:
         mshape = shape
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+
+    from ...static.program import Variable, default_main_program, in_static_build
+
+    if in_static_build() and isinstance(x, Variable):
+        prog = default_main_program()
+        return _dropout_static_raw(x, prog.rng_var(), p=float(p),
+                                   mshape=tuple(mshape), scale=scale,
+                                   seed_offset=prog.next_rng_offset())
+
     keep = jax.random.bernoulli(rnd.next_key(), 1.0 - p, tuple(mshape))
     mask = Tensor(keep.astype(x._value.dtype))
-    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
     return _dropout_masked(x, mask, scale=scale)
 
 

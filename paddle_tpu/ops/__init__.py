@@ -32,6 +32,7 @@ from .random import (  # noqa: F401
 )
 
 from . import creation, math, manipulation, logic, linalg, search, stat  # noqa: F401
+from . import fused  # noqa: F401
 
 
 # --------------------------------------------------------------------------

@@ -21,7 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from ..autograd.engine import Edge, GradNode, is_grad_enabled
+from ..autograd.engine import Edge, GradNode, is_grad_enabled, leaf_edge as _leaf_edge
 from ..framework import dtype as dtypes
 from ..framework.tensor import Tensor
 
@@ -40,12 +40,6 @@ STATIC_RECORDER = None
 
 def _needs_grad(t: Tensor) -> bool:
     return (not t.stop_gradient) and dtypes.is_differentiable(t.dtype)
-
-
-def _leaf_edge(t: Tensor) -> Edge:
-    if t._grad_node is not None:
-        return Edge(node=t._grad_node, slot=t._out_slot)
-    return Edge(leaf=t)
 
 
 def apply_op(name, fwd, args, static_kwargs):
@@ -75,6 +69,7 @@ def apply_op(name, fwd, args, static_kwargs):
 
     if not diff_pos:
         out = fwd(*vals, **static_kwargs)
+        _check_nan_inf(name, out)
         return _wrap_outputs(out, node=None)
 
     diff_vals = [vals[i] for i in diff_pos]
@@ -90,8 +85,33 @@ def apply_op(name, fwd, args, static_kwargs):
     multi = isinstance(primal_out, (tuple, list))
     outs = list(primal_out) if multi else [primal_out]
     out_info = [(o.shape, o.dtype) for o in outs]
-    node = GradNode(name, vjp_fn, edges, out_info, multi)
+    # fwd_closed + primal Tensor refs enable create_graph=True (double
+    # backward): the traversal re-records this vjp over (primals, cotangents)
+    node = GradNode(name, vjp_fn, edges, out_info, multi,
+                    fwd_closed=closed, inputs=[args[i] for i in diff_pos])
+    _check_nan_inf(name, primal_out)
     return _wrap_outputs(primal_out, node=node)
+
+
+def _check_nan_inf(name, out):
+    """FLAGS_check_nan_inf debug scan (reference
+    ``framework/details/nan_inf_utils_detail.cc``; eager version
+    ``eager/nan_inf_utils.cc``). Eager-mode only — traced values are skipped
+    (inside jit the GradScaler's found_inf path covers it)."""
+    from ..framework.flags import flag_value
+
+    if not flag_value("check_nan_inf"):
+        return
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    for o in outs:
+        if isinstance(o, jax.core.Tracer) or not hasattr(o, "dtype"):
+            continue
+        if jnp.issubdtype(o.dtype, jnp.floating):
+            if not bool(jnp.isfinite(o).all()):
+                raise FloatingPointError(
+                    f"Operator {name} output contains Inf/Nan "
+                    f"(FLAGS_check_nan_inf is set)."
+                )
 
 
 def _wrap_outputs(out, node):

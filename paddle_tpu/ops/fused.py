@@ -1,0 +1,142 @@
+"""Fused ops for the memory-bound tails of transformer training.
+
+Reference analogues: ``paddle/fluid/operators/fused/fused_softmax_mask.cu.h``
+and ``paddle/phi/kernels/gpu/cross_entropy_kernel.cu`` (their answer to the
+softmax/CE bandwidth problem). TPU-native redesign: the LM head matmul and the
+softmax cross-entropy are fused into ONE chunked op with a custom VJP, so the
+full ``[tokens, vocab]`` logits tensor is never materialized in HBM — neither
+in forward nor in backward. Each chunk's logits live only as a fused-scan
+temporary; the MXU does the matmuls, fp32 statistics ride in registers.
+
+For GPT-2 124M at b16xs1024 the un-fused path writes+reads a 3.3 GB fp32
+logits tensor twice per step; this op removes all of that traffic.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .dispatch import op
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def _pick_chunk(tokens: int) -> int:
+    # largest power-of-two chunk <= 2048 dividing the padded token count;
+    # 2048x50k fp32 chunk logits ~ 400 MB transient, well inside HBM while
+    # keeping the per-chunk matmul MXU-saturating.
+    for c in (2048, 1024, 512, 256, 128):
+        if tokens >= c:
+            return c
+    return tokens
+
+
+def _chunked(x, chunk):
+    n = x.shape[0]
+    pad = (-n) % chunk
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:], x.dtype)], 0)
+    return x.reshape((x.shape[0] // chunk, chunk) + x.shape[1:])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flce(h, w, labels, ignore_index, chunk):
+    losses, _ = _flce_fwd(h, w, labels, ignore_index, chunk)
+    return losses
+
+
+def _flce_fwd(h, w, labels, ignore_index, chunk):
+    tokens = h.shape[0]
+    chunk = chunk or _pick_chunk(tokens)
+    y = labels.astype(jnp.int32)
+    safe = jnp.where(y == ignore_index, 0, y)
+    h_b = _chunked(h, chunk)
+    y_b = _chunked(safe, chunk)
+    vocab = w.shape[0]
+
+    def body(_, inp):
+        h_c, y_c = inp
+        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32)  # [C,V]
+        m = jnp.max(logits, axis=-1)
+        # one fused read pass computes both the exp-sum and the label logit
+        # (iota-compare one-hot instead of gather: stays in the elementwise
+        # fusion, no scatter/gather op on the [C,V] block)
+        eq = (lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+              == y_c[:, None]).astype(jnp.float32)
+        lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+        picked = jnp.sum(logits * eq, axis=-1)
+        return None, (lse - picked, lse)
+
+    _, (loss_b, lse_b) = lax.scan(body, None, (h_b, y_b))
+    losses = loss_b.reshape(-1)[:tokens]
+    losses = jnp.where(y == ignore_index, 0.0, losses)
+    return losses, (h, w, safe, y == ignore_index, lse_b)
+
+
+def _flce_bwd(ignore_index, chunk, res, g):
+    h, w, safe, ignored, lse_b = res
+    tokens = h.shape[0]
+    chunk = chunk or _pick_chunk(tokens)
+    g = jnp.where(ignored, 0.0, g.astype(jnp.float32))
+    h_b = _chunked(h, chunk)
+    y_b = _chunked(safe, chunk)
+    g_b = _chunked(g, chunk)
+
+    def body(dw_acc, inp):
+        h_c, y_c, g_c, lse_c = inp
+        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32)
+        # softmax from the saved forward lse: single fused pass, no max/sum
+        # re-reduction; one-hot via iota compare keeps this scatter-free
+        eq = (lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+              == y_c[:, None]).astype(jnp.float32)
+        dl = ((jnp.exp(logits - lse_c[:, None]) - eq)
+              * g_c[:, None]).astype(w.dtype)              # [C, V] bf16
+        dh_c = jnp.dot(dl, w)                              # [C, H]
+        dw_acc = dw_acc + jnp.dot(dl.T, h_c, preferred_element_type=jnp.float32)
+        return dw_acc, dh_c
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    dw, dh_b = lax.scan(body, dw0, (h_b, y_b, g_b, lse_b))
+    dh = dh_b.reshape(-1, h.shape[-1])[:tokens].astype(h.dtype)
+    return dh, dw.astype(w.dtype), None
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+@op("fused_linear_cross_entropy")
+def _flce_op(hidden, weight, labels, ignore_index=-100, reduction="mean",
+             chunk=0):
+    tokens = 1
+    for d in hidden.shape[:-1]:
+        tokens *= d
+    h2 = hidden.reshape(tokens, hidden.shape[-1])
+    y = labels.reshape(tokens)
+    losses = _flce(h2, weight, y, ignore_index, chunk)
+    if reduction == "none":
+        return losses.reshape(labels.shape)
+    valid = jnp.sum((y != ignore_index).astype(jnp.float32))
+    total = jnp.sum(losses)
+    if reduction == "sum":
+        return total
+    return total / jnp.maximum(valid, 1.0)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               reduction="mean", chunk=0, name=None):
+    """``cross_entropy(hidden @ weight.T, labels)`` without materializing
+    logits.
+
+    Args:
+        hidden: ``[..., hidden_size]`` activations (bf16/f32).
+        weight: ``[vocab, hidden_size]`` LM head / tied embedding weight.
+        labels: integer class ids, shape ``hidden.shape[:-1]``.
+        ignore_index: label value excluded from the loss and the mean.
+        reduction: ``"mean" | "sum" | "none"``.
+        chunk: token-chunk size (0 = auto).
+    """
+    return _flce_op(hidden, weight, labels, ignore_index=ignore_index,
+                    reduction=reduction, chunk=int(chunk))

@@ -426,10 +426,13 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
 
     Returns ``(batch, seq_q, heads, head_dim)``.
     """
+    from ...framework.flags import flag_value
     from . import interpret_requested
 
     if interpret is None:
         interpret = interpret_requested()
+    block_q = flag_value("flash_attention_block_q") or block_q
+    block_k = flag_value("flash_attention_block_k") or block_k
     b, sq, h, d = q.shape
     sk = k.shape[1]
     block_q = _pick_block(sq, block_q)

@@ -1,0 +1,21 @@
+"""paddle.profiler (reference ``python/paddle/profiler/__init__.py``)."""
+from .profiler import (  # noqa: F401
+    Profiler,
+    ProfilerState,
+    ProfilerTarget,
+    RecordEvent,
+    SortedKeys,
+    export_chrome_tracing,
+    export_protobuf,
+    get_profiler,
+    in_profiler_mode,
+    load_profiler_result,
+    make_scheduler,
+    wrap_optimizers,
+)
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
+    "load_profiler_result", "SortedKeys",
+]

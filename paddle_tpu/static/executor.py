@@ -61,6 +61,13 @@ class Executor:
         fetch_list = fetch_list or []
         if not program.ops and not program._optimizers:
             return []  # startup program: params are eagerly initialized
+        if program.uses_rng():
+            # fresh per-run randomness for in-graph random ops (dropout):
+            # draw from the global generator so paddle.seed stays authoritative
+            from ..framework import random as rnd
+
+            feed = dict(feed)
+            feed["__rng_key__"] = jax.random.key_data(rnd.next_key())
 
         fetch_vars = [
             v if isinstance(v, Variable) else self._lookup(program, v)
@@ -70,7 +77,7 @@ class Executor:
         opts = [o for o, _ in program._optimizers]
 
         key = (
-            id(program), program._version,
+            program._uid, program._version,
             tuple(sorted(feed.keys())),
             tuple(v.name for v in fetch_vars),
         )
@@ -102,6 +109,9 @@ class Executor:
             for v in node.outs:
                 if v.name == name:
                     return v
+        for gv in program._grad_vars.values():
+            if gv.name == name:
+                return gv
         raise KeyError(f"fetch target {name!r} not found in program")
 
     def _build(self, program, feed_names, fetch_vars, params, opts):

@@ -80,6 +80,9 @@ class Program:
         self._grad_vars = {}    # param name -> Variable for param@GRAD
         self.random_seed = None
         self._version = 0
+        self._rng_counter = 0   # per-node fold offsets for random ops
+        # process-unique id for executor caching: id() can be reused after GC
+        self._uid = next(_name_counter)
 
     # reference Program API shims
     def global_block(self):
@@ -98,10 +101,28 @@ class Program:
         p = Program()
         p.ops = list(self.ops)
         p.placeholders = dict(self.placeholders)
+        p._rng_counter = self._rng_counter
         if not for_test:
             p._optimizers = list(self._optimizers)
             p._grad_vars = dict(self._grad_vars)
         return p
+
+    def uses_rng(self) -> bool:
+        return "__rng_key__" in self.placeholders
+
+    def rng_var(self):
+        """The per-run RNG key feed (``uint32[2]`` raw key data), injected by
+        the Executor from the global generator on every run — random ops fold
+        a per-node offset into it (see nn/functional dropout)."""
+        v = self.placeholders.get("__rng_key__")
+        if v is None:
+            v = Variable("__rng_key__", [2], "uint32", program=self)
+            self.placeholders["__rng_key__"] = v
+        return v
+
+    def next_rng_offset(self) -> int:
+        self._rng_counter += 1
+        return self._rng_counter
 
     def list_vars(self):
         out = list(self.placeholders.values())

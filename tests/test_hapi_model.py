@@ -1,0 +1,159 @@
+"""hapi Model: prepare/fit/evaluate/predict/save/load + callbacks + summary.
+Reference: python/paddle/hapi/model.py:915,1574, hapi/callbacks.py,
+python/paddle/tests/test_model.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import EarlyStopping, ModelCheckpoint
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.nn import CrossEntropyLoss
+
+
+class _ToyClassify(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 2)
+    )
+
+
+def _prepared_model(lr=0.1):
+    paddle.seed(0)
+    net = _mlp()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=lr, parameters=net.parameters())
+    model.prepare(opt, CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_decreases_loss_and_tracks_acc():
+    model = _prepared_model()
+    ds = _ToyClassify(64)
+    first, last = [], []
+
+    class Track(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            (first if not first else last).clear() if False else None
+            last.append(logs["loss"])
+            if len(last) == 1:
+                first.append(logs["loss"])
+
+    logs = model.fit(ds, batch_size=16, epochs=8, verbose=0, callbacks=[Track()])
+    assert last[-1] < first[0], f"loss did not decrease: {first[0]} -> {last[-1]}"
+    assert logs["acc"] > 0.8
+    assert "loss" in logs
+
+
+def test_evaluate_and_predict():
+    model = _prepared_model()
+    ds = _ToyClassify(64)
+    model.fit(ds, batch_size=16, epochs=6, verbose=0)
+    ev = model.evaluate(_ToyClassify(32, seed=1), batch_size=16, verbose=0)
+    assert "loss" in ev and "acc" in ev
+    assert ev["eval_samples"] == 32
+
+    preds = model.predict(_ToyClassify(32, seed=1), batch_size=16,
+                          stack_outputs=True, verbose=0)
+    assert len(preds) == 1 and preds[0].shape == (32, 2)
+
+
+def test_train_eval_batch_api():
+    model = _prepared_model()
+    x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    y = np.zeros((16,), np.int64)
+    (l0,) = model.train_batch([x], [y])
+    (l1,) = model.train_batch([x], [y])
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+    ev = model.eval_batch([x], [y])
+    assert np.isfinite(ev[0])
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = _prepared_model()
+    ds = _ToyClassify(32)
+    model.fit(ds, batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = _prepared_model(lr=0.0)
+    model2.load(path)
+    x = np.ones((4, 8), np.float32)
+    p1 = model.predict_batch([x])[0]
+    p2 = model2.predict_batch([x])[0]
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
+
+
+def test_model_checkpoint_callback(tmp_path):
+    model = _prepared_model()
+    save_dir = str(tmp_path / "auto")
+    model.fit(_ToyClassify(32), batch_size=16, epochs=2, verbose=0,
+              save_dir=save_dir, save_freq=1)
+    assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+    assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+
+def test_early_stopping_stops():
+    model = _prepared_model(lr=0.0)  # frozen -> metric never improves
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                       save_best_model=False)
+    stopped = []
+
+    class CountEpochs(Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            stopped.append(epoch)
+
+    model.fit(_ToyClassify(32), eval_data=_ToyClassify(16, seed=2),
+              batch_size=16, epochs=10, verbose=0,
+              callbacks=[es, CountEpochs()])
+    assert len(stopped) < 10, "early stopping never fired"
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(0)
+    net = _mlp()
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, CrossEntropyLoss())
+    model.fit(_ToyClassify(32), batch_size=16, epochs=1, verbose=0)
+    # 2 steps/epoch, step_size=2 -> one decay
+    assert sched.last_lr < 0.1
+
+
+def test_summary():
+    net = _mlp()
+    info = paddle.summary(net, (4, 8))
+    # 8*16+16 + 16*2+2 = 178
+    assert info["total_params"] == 178
+    assert info["trainable_params"] == 178
+
+
+def test_prepare_type_errors():
+    net = _mlp()
+    model = paddle.Model(net)
+    with pytest.raises(TypeError):
+        model.prepare(None, loss=123)
+    with pytest.raises(RuntimeError):
+        model.train_batch([np.zeros((2, 8), np.float32)], [np.zeros(2, np.int64)])

@@ -1,0 +1,91 @@
+"""paddle.profiler: scheduler state machine, RecordEvent capture, chrome
+export, summary (reference python/paddle/profiler/profiler.py:271)."""
+import glob
+import json
+import os
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, export_chrome_tracing,
+    load_profiler_result, make_scheduler,
+)
+
+
+def test_make_scheduler_states():
+    sched = make_scheduler(closed=1, ready=1, record=4, repeat=1, skip_first=1)
+    states = [sched(i) for i in range(9)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3:6] == [ProfilerState.RECORD] * 3
+    assert states[6] == ProfilerState.RECORD_AND_RETURN
+    assert states[7] == ProfilerState.CLOSED          # repeat exhausted
+    assert states[8] == ProfilerState.CLOSED
+
+
+def test_record_event_noop_outside_profiler():
+    ev = RecordEvent("nothing")
+    ev.begin()
+    ev.end()  # must not raise, must not record
+
+
+def test_profiler_captures_train_step(tmp_path):
+    traces = []
+
+    def on_ready(prof):
+        traces.append(prof.profiler_result)
+
+    lin = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    scheduler=make_scheduler(closed=0, ready=1, record=2, repeat=1),
+                    on_trace_ready=on_ready)
+    prof.start()
+    for i in range(4):
+        with RecordEvent("train_step"):
+            loss = lin(x).square().mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        prof.step()
+    prof.stop()
+
+    assert traces, "on_trace_ready never fired"
+    names = [e.name for e in traces[0].events]
+    assert "train_step" in names
+    assert any(n.endswith(".step") for n in names), f"optimizer span missing: {names}"
+    # summary builds a table over captured spans
+    table = prof.summary()
+    assert "train_step" in table
+
+
+def test_chrome_export_roundtrip(tmp_path):
+    out = str(tmp_path / "traces")
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    on_trace_ready=export_chrome_tracing(out, worker_name="w0"))
+    with prof:
+        with RecordEvent("span_a"):
+            time.sleep(0.001)
+    files = glob.glob(os.path.join(out, "w0*.json"))
+    assert files
+    result = load_profiler_result(files[0])
+    assert any(e.name == "span_a" for e in result.events)
+    data = json.load(open(files[0]))
+    assert data["traceEvents"][0]["ph"] == "X"
+
+
+def test_context_manager_with_step_range_scheduler():
+    with Profiler(targets=[ProfilerTarget.CPU], scheduler=(1, 3),
+                  on_trace_ready=lambda p: None) as prof:
+        for _ in range(4):
+            with RecordEvent("w"):
+                pass
+            prof.step()
+    assert prof.step_num == 4
+    assert "step" in prof.step_info()

@@ -1,0 +1,92 @@
+"""Separate relay/dispatch latency from on-device step time.
+
+Three measurements on the real chip:
+  1. trivial jitted add with fresh inputs -> pure round-trip latency
+  2. GPT full step, per-step loss fetch (bench.py's current fencing)
+  3. GPT full step, N chained steps then ONE fetch — the state returned by
+     step i feeds step i+1, so every call has distinct inputs (no replay
+     caching possible) and the aggregate time is honest.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    # 1. round-trip latency
+    @jax.jit
+    def triv(x):
+        return x + 1.0
+
+    xs = [np.full((8,), i, np.float32) for i in range(8)]
+    np.asarray(triv(xs[0]))
+    ts = []
+    for x in xs:
+        t0 = time.perf_counter()
+        np.asarray(triv(x))
+        ts.append(time.perf_counter() - t0)
+    print(f"trivial round-trip: median {np.median(ts) * 1e3:.2f} ms "
+          f"min {min(ts) * 1e3:.2f} ms", flush=True)
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_position_embeddings=1024,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    batch, seq = 16, 1024
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    for name, sub in model.named_sublayers():
+        if type(sub).__name__ == "LayerNorm":
+            sub.to(dtype="float32")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+
+    def train_step(ids, labels):
+        loss = model.loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = CompiledStep(train_step, stateful=[model, opt], donate_state=True)
+    rng = np.random.RandomState(time.time_ns() % (2**31))
+    n = 14
+    batches = [Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+               for _ in range(n)]
+    for i in range(3):
+        np.asarray(step(batches[i], batches[i])._value)
+
+    # 2. per-step fetch
+    ts = []
+    for i in range(3, 8):
+        t0 = time.perf_counter()
+        np.asarray(step(batches[i], batches[i])._value)
+        ts.append(time.perf_counter() - t0)
+    per_step = float(np.median(ts))
+    print(f"per-step fetch:     {per_step * 1e3:.1f} ms  "
+          f"{batch * seq / per_step:.0f} tok/s", flush=True)
+
+    # 3. chained, one fetch
+    t0 = time.perf_counter()
+    losses = [step(batches[i], batches[i]) for i in range(8, 14)]
+    vals = [float(np.asarray(l._value)) for l in losses]
+    total = time.perf_counter() - t0
+    per = total / 6
+    print(f"chained x6, 1 fetch: {per * 1e3:.1f} ms/step  "
+          f"{batch * seq / per:.0f} tok/s  losses finite={np.isfinite(vals).all()}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
