@@ -13,6 +13,11 @@ from .mp_layers import (  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pipeline_schedule import PipelinedModel, build_pipelined_gpt  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    gather_sequence,
+    ring_attention,
+    split_sequence,
+)
 from .tensor_parallel import TensorParallel  # noqa: F401
 from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
@@ -27,6 +32,9 @@ __all__ = [
     "SharedLayerDesc",
     "PipelineParallel",
     "TensorParallel",
+    "ring_attention",
+    "split_sequence",
+    "gather_sequence",
     "HybridParallelOptimizer",
     "RNGStatesTracker",
     "get_rng_state_tracker",

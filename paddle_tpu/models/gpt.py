@@ -55,6 +55,7 @@ class GPTConfig:
     initializer_range: float = 0.02
     layer_norm_eps: float = 1e-5
     use_tp: bool = False       # tensor-parallel projections (needs fleet mp>1)
+    use_sep: bool = False      # ring-attention sequence parallelism (sep>1)
     tie_embeddings: bool = True
 
     @property
@@ -67,6 +68,13 @@ def _mp_degree():
 
     hcg = get_hybrid_communicate_group()
     return hcg.get_model_parallel_world_size() if hcg is not None else 1
+
+
+def _sep_degree():
+    from ..distributed.fleet.base.fleet_base import get_hybrid_communicate_group
+
+    hcg = get_hybrid_communicate_group()
+    return hcg.get_sep_parallel_world_size() if hcg is not None else 1
 
 
 class GPTEmbeddings(Layer):
@@ -132,6 +140,16 @@ class GPTDecoderLayer(Layer):
         self.attn_dropout = cfg.attention_dropout
         self.resid_dropout = Dropout(cfg.hidden_dropout, mode="upscale_in_train")
         self.num_heads = nh
+        # ring-attention sequence parallelism over the sep mesh axis
+        # (distributed/meta_parallel/sequence_parallel.py — green-field,
+        # SURVEY §5; the reference has no SP/CP path)
+        self._use_sep = cfg.use_sep and _sep_degree() > 1
+        if self._use_sep and cfg.attention_dropout > 0:
+            raise ValueError(
+                "use_sep with attention_dropout>0 is not supported: the ring "
+                "schedule has no per-chunk dropout path yet — set "
+                "attention_dropout=0 (hidden_dropout is fine)"
+            )
 
     def forward(self, x, attn_mask=None, cache=None):
         b, s, h = x.shape
@@ -147,11 +165,16 @@ class GPTDecoderLayer(Layer):
             k = ops.concat([cache[0], k], axis=1)
             v = ops.concat([cache[1], v], axis=1)
             cache = (k.detach(), v.detach())
-        attn = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.attn_dropout if self.training else 0.0,
-            is_causal=cache is None,
-        )
+        if self._use_sep and cache is None and attn_mask is None:
+            from ..distributed.meta_parallel import ring_attention
+
+            attn = ring_attention(q, k, v, is_causal=True)
+        else:
+            attn = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.attn_dropout if self.training else 0.0,
+                is_causal=cache is None,
+            )
         attn = attn.reshape([b, s, local_width])
         x = residual + self.resid_dropout(self.out_proj(attn))
 
@@ -172,6 +195,13 @@ class GPTModel(Layer):
 
     def forward(self, input_ids, position_ids=None, attn_mask=None):
         h = self.embeddings(input_ids, position_ids)
+        # gate on the layers' frozen decision (made at construction against
+        # the then-active hybrid mesh) so annotation and attention path agree
+        if len(self.layers) and self.layers[0]._use_sep:
+            from ..distributed.meta_parallel import split_sequence
+
+            # keep activations sequence-sharded over sep between blocks
+            h = split_sequence(h)
         for layer in self.layers:
             h = layer(h, attn_mask=attn_mask)
         return self.ln_f(h)

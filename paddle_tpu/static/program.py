@@ -105,6 +105,18 @@ class Program:
         if not for_test:
             p._optimizers = list(self._optimizers)
             p._grad_vars = dict(self._grad_vars)
+        else:
+            # reference clone(for_test=True) rewrites dropout to inference
+            # behavior (framework.py Program.clone); upscale_in_train dropout
+            # is identity at eval, so replace the op with a pass-through
+            def _identity(x, key_data, **kw):
+                return x
+
+            p.ops = [
+                _OpNode(n.op_name, _identity, n.args, n.kwargs, n.outs)
+                if n.op_name == "dropout" else n
+                for n in self.ops
+            ]
         return p
 
     def uses_rng(self) -> bool:

@@ -155,3 +155,28 @@ def test_static_dropout_grad_consistent_with_forward():
         assert np.isfinite(g).all() and (g != 0).any()
     finally:
         paddle.disable_static()
+
+
+def test_static_clone_for_test_disables_dropout():
+    """Program.clone(for_test=True) must run dropout as identity (reference
+    clone(for_test=True) semantics)."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [4, 16], "float32")
+            d = F.dropout(x, p=0.5, training=True)
+        eval_prog = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        (r1,) = exe.run(eval_prog, feed={"x": xv}, fetch_list=[d.name])
+        (r2,) = exe.run(eval_prog, feed={"x": xv}, fetch_list=[d.name])
+        np.testing.assert_allclose(r1, xv, atol=1e-7)  # identity, no mask
+        np.testing.assert_allclose(r1, r2)
+        # the train program still masks
+        (t1,) = exe.run(main, feed={"x": xv}, fetch_list=[d.name])
+        assert (t1 == 0).any()
+    finally:
+        paddle.disable_static()
