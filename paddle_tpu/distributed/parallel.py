@@ -91,14 +91,28 @@ class ParallelEnv:
 def init_parallel_env():
     """reference ``distributed/parallel.py:94``. On TPU: multi-host jax
     initialization (controller discovery from slice metadata); single-host is
-    a no-op since all local chips are already visible to this process."""
+    a no-op since all local chips are already visible to this process.
+
+    Under ``python -m paddle_tpu.distributed.launch`` the coordinator address
+    and rank/world env come from the launcher (PADDLE_* surface); with
+    ``--backend gloo`` cross-process CPU collectives are enabled (the
+    reference's Gloo fallback for GPU-less testing)."""
     global _initialized
     if _initialized:
         return ParallelEnv()
     coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
-    if coord and jax.process_count() == 1 and os.environ.get("PADDLE_TRAINERS_NUM"):
+    # NOTE: no jax API may run before jax.distributed.initialize — even
+    # jax.devices()/process_count() would initialize the XLA backend.
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax
+        already = False
+    if coord and not already and os.environ.get("PADDLE_TRAINERS_NUM"):
+        if os.environ.get("PADDLE_DISTRIBUTED_BACKEND", "") == "gloo":
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=int(os.environ["PADDLE_TRAINERS_NUM"]),

@@ -29,16 +29,28 @@ from ..collective import Group
 __all__ = ["group_sharded_parallel", "save_group_sharded_model", "ShardedLayer"]
 
 
-def _axis_sharding(group, ndim, shape):
+def _axis_sharding(group, ndim, shape, offload=False):
     """Shard dim0 over the group axis when divisible, else replicate (the
-    reference pads/flattens into rank buffers; XLA needs divisibility)."""
-    if ndim >= 1 and shape[0] % group.nranks == 0 and shape[0] > 0:
-        return NamedSharding(group.mesh, P(group.axis_name))
-    return NamedSharding(group.mesh, P())
+    reference pads/flattens into rank buffers; XLA needs divisibility).
+    ``offload=True`` additionally places the buffer in host memory
+    (reference offload_helper.py; TPU: pinned_host memory space)."""
+    spec = (P(group.axis_name)
+            if ndim >= 1 and shape[0] % group.nranks == 0 and shape[0] > 0
+            else P())
+    sh = NamedSharding(group.mesh, spec)
+    if offload:
+        try:
+            sh = sh.with_memory_kind("pinned_host")
+        except Exception:
+            pass  # backend without host memory space: keep device placement
+    return sh
 
 
-def _shard_value(v, group):
-    return jax.device_put(v, _axis_sharding(group, v.ndim, v.shape))
+def _shard_value(v, group, offload=False):
+    if isinstance(v, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(
+            v, _axis_sharding(group, v.ndim, v.shape))
+    return jax.device_put(v, _axis_sharding(group, v.ndim, v.shape, offload))
 
 
 def _sharding_group(group):
@@ -95,29 +107,26 @@ class ShardedLayer(Layer):
 
 
 class _ShardedOptimizer:
-    """Stage-1/2 optimizer wrapper: accumulators (and stage2: grads) are
-    sharded over the group axis (reference GroupShardedOptimizerStage2)."""
+    """Stage-1/2 optimizer wrapper (reference GroupShardedOptimizerStage2):
+    accumulators are sharded AT CREATION via the optimizer's placement hook
+    (never materialized replicated); stage-2 grads are sharded at production
+    by the param's ``_grad_sharding`` (framework/tensor.py
+    ``_accumulate_grad``)."""
 
-    def __init__(self, optimizer, group, shard_grads):
+    def __init__(self, optimizer, group, offload=False):
         self._inner_opt = optimizer
         self._group = group
-        self._shard_grads = shard_grads
+        self._offload = offload
+        # offload note: host placement applies to the eager path (device_put
+        # with pinned_host); inside a jitted step the tracer branch keeps the
+        # sharding constraint only — placement of the state outputs then
+        # follows the compiled executable's output shardings
+        optimizer._accumulator_transform = (
+            lambda arr: _shard_value(arr, group, offload=offload)
+        )
 
     def step(self):
-        g = self._group
-        if self._shard_grads:
-            for p in self._inner_opt._parameter_list or []:
-                if p.grad is not None:
-                    p.grad._value = _shard_value(p.grad._value, g)
         self._inner_opt.step()
-        # shard the accumulators the step just created/updated (raw jnp
-        # arrays in Optimizer._accumulators[name][param_key])
-        for store in getattr(self._inner_opt, "_accumulators", {}).values():
-            if not isinstance(store, dict):
-                continue
-            for key, acc in store.items():
-                if hasattr(acc, "ndim") and acc.ndim >= 1:
-                    store[key] = _shard_value(acc, g)
 
     def clear_grad(self, set_to_zero=True):
         self._inner_opt.clear_grad(set_to_zero=set_to_zero)
@@ -149,8 +158,12 @@ def group_sharded_parallel(
         repl = NamedSharding(g.mesh, P())
         for p in model.parameters(include_sublayers=True):
             p._value = jax.device_put(p._value, repl)
+    if level in ("os_g", "p_g_os"):
+        # stage-2/3: shard gradients the moment backward deposits them
+        for p in model.parameters(include_sublayers=True):
+            p._grad_sharding = _axis_sharding(g, p._value.ndim, p._value.shape)
     if optimizer is not None:
-        optimizer = _ShardedOptimizer(optimizer, g, shard_grads=level != "os")
+        optimizer = _ShardedOptimizer(optimizer, g, offload=offload)
     return model, optimizer, scaler
 
 

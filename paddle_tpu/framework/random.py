@@ -22,14 +22,28 @@ __all__ = ["seed", "Generator", "default_generator", "next_key",
 
 
 class Generator:
+    """The key is created LAZILY on first use: merely importing paddle_tpu
+    must not initialize the XLA backend (jax.distributed.initialize in
+    init_parallel_env requires a pristine process)."""
+
     def __init__(self, seed_val: int = 0):
-        self._key = jax.random.key(seed_val)
+        self._lazy_key = None
         self._seed = seed_val
         self._derive_base = None   # set by derive_scope (scan-tick RNG)
         self._derive_count = 0
 
+    @property
+    def _key(self):
+        if self._lazy_key is None:
+            self._lazy_key = jax.random.key(self._seed)
+        return self._lazy_key
+
+    @_key.setter
+    def _key(self, v):
+        self._lazy_key = v
+
     def manual_seed(self, seed_val: int):
-        self._key = jax.random.key(int(seed_val))
+        self._lazy_key = jax.random.key(int(seed_val))
         self._seed = int(seed_val)
         return self
 

@@ -167,6 +167,14 @@ class Tensor:
     def _accumulate_grad(self, cot):
         if cot.dtype != self._value.dtype:
             cot = cot.astype(self._value.dtype)
+        # ZeRO stage-2: grads are sharded AT PRODUCTION over the sharding
+        # axis (set by group_sharded_parallel), never materialized replicated
+        sh = getattr(self, "_grad_sharding", None)
+        if sh is not None:
+            if _is_tracer(cot):
+                cot = jax.lax.with_sharding_constraint(cot, sh)
+            else:
+                cot = jax.device_put(cot, sh)
         if self._grad is None:
             self._grad = Tensor(cot, stop_gradient=True)
         else:

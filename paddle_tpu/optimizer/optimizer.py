@@ -50,6 +50,10 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._accumulators = {}
         self._acc_meta = {}  # (name, key) -> (fill_value, shape, dtype)
+        # optional placement hook applied to every accumulator AT CREATION
+        # (ZeRO sharding / offload — distributed/sharding/group_sharded.py);
+        # avoids ever materializing a full-size replicated buffer
+        self._accumulator_transform = None
         # fp32 master weights + fp32 moments for low-precision params
         # (reference adam_op multi-precision path / amp O2 master weights)
         self._multi_precision = bool(multi_precision)
@@ -101,6 +105,8 @@ class Optimizer:
                     fill_value,
                     dtype or (param._value.dtype if dtypes.is_floating(param.dtype) else jnp.float32),
                 )
+            if self._accumulator_transform is not None:
+                store[key] = self._accumulator_transform(store[key])
             # GradScaler's inf-skip needs the pre-step value of accumulators
             # born mid-step; keep only metadata, never a full-size buffer.
             self._acc_meta[(name, key)] = (
@@ -130,6 +136,8 @@ class Optimizer:
                 store[key] = jnp.asarray(pending, jnp.float32)
             else:
                 store[key] = p._value.astype(jnp.float32)
+            if self._accumulator_transform is not None:
+                store[key] = self._accumulator_transform(store[key])
             # fill=None marks "pre-step value is the param itself" for the
             # GradScaler inf-skip restore path
             self._acc_meta[("master_weight", key)] = (
@@ -140,6 +148,11 @@ class Optimizer:
         return store[key]
 
     def _set_accumulator(self, name, param, value):
+        # re-apply the ZeRO placement every store: eager updates would
+        # otherwise migrate offloaded/sharded state back to default device
+        # memory after the first step
+        if self._accumulator_transform is not None:
+            value = self._accumulator_transform(value)
         self._accumulators[name][self._pkey(param)] = value
 
     # -- main API ------------------------------------------------------------

@@ -107,13 +107,16 @@ class Program:
             p._grad_vars = dict(self._grad_vars)
         else:
             # reference clone(for_test=True) rewrites dropout to inference
-            # behavior (framework.py Program.clone); upscale_in_train dropout
-            # is identity at eval, so replace the op with a pass-through
-            def _identity(x, key_data, **kw):
+            # behavior (framework.py Program.clone): upscale_in_train
+            # (scale=1/(1-p)) is identity at eval; downscale_in_infer
+            # (scale=1.0 recorded) becomes x*(1-p)
+            def _infer_dropout(x, key_data, p=0.5, scale=1.0, **kw):
+                if scale == 1.0 and p > 0.0:
+                    return x * (1.0 - p)
                 return x
 
             p.ops = [
-                _OpNode(n.op_name, _identity, n.args, n.kwargs, n.outs)
+                _OpNode(n.op_name, _infer_dropout, n.args, n.kwargs, n.outs)
                 if n.op_name == "dropout" else n
                 for n in self.ops
             ]

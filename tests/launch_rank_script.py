@@ -1,0 +1,73 @@
+"""Rank script for the two-process launch test (reference pattern:
+``unittests/test_collective_base.py`` rank scripts). Run by
+``python -m paddle_tpu.distributed.launch --nproc_per_node 2 --backend gloo``.
+
+Exercises the REAL multi-controller path: jax.distributed.initialize via
+init_parallel_env, a cross-process psum, and a data-parallel train step on a
+2-process global mesh."""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.framework.tensor import Tensor
+
+env = dist.init_parallel_env()
+rank, world = env.rank, env.world_size
+assert world == 2, world
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+devs = jax.devices()
+assert len(devs) == 2, f"expected 2 global devices, got {devs}"
+assert jax.process_count() == 2
+
+mesh = Mesh(np.array(devs), ("dp",))
+
+# 1. cross-process all-reduce parity
+local = np.full((1, 4), float(rank + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), local, (2, 4)
+)
+total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+got = float(np.asarray(jax.device_get(total)))
+assert got == 12.0, got
+
+# 2. data-parallel train step: per-process batch shard, psum'd grads via the
+# global-mesh jit — loss and updated weights must match on both ranks
+paddle.seed(0)
+lin = paddle.nn.Linear(4, 1)
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+
+rng = np.random.RandomState(100 + rank)  # different data per rank
+x_local = rng.randn(2, 4).astype(np.float32)
+x_global = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), x_local, (4, 4)
+)
+
+from paddle_tpu.jit.functionalize import CompiledStep
+
+
+def step(x):
+    loss = lin(x).square().mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+cs = CompiledStep(step, stateful=[lin, opt], donate_state=False)
+loss = cs(Tensor(x_global))
+loss_val = float(np.asarray(jax.device_get(loss._value)))
+w_after = np.asarray(jax.device_get(lin.weight._value)).ravel().tolist()
+
+out_dir = os.environ["LAUNCH_TEST_OUT"]
+with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "world": world, "psum": got,
+               "loss": loss_val, "w": w_after}, f)
+print(f"rank {rank} OK", flush=True)

@@ -180,3 +180,22 @@ def test_static_clone_for_test_disables_dropout():
         assert (t1 == 0).any()
     finally:
         paddle.disable_static()
+
+
+def test_static_clone_for_test_downscale_mode():
+    """downscale_in_infer dropout must become x*(1-p) at eval, not identity."""
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [4, 16], "float32")
+            d = F.dropout(x, p=0.5, training=True, mode="downscale_in_infer")
+        eval_prog = main.clone(for_test=True)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        (r,) = exe.run(eval_prog, feed={"x": xv}, fetch_list=[d.name])
+        np.testing.assert_allclose(r, 0.5 * xv, atol=1e-7)
+    finally:
+        paddle.disable_static()

@@ -1,0 +1,177 @@
+"""ZeRO stages must actually shard memory (VERDICT weak #2): per-device
+bytes of grads/accumulators/params shrink ~1/N, grads are sharded at
+production, accumulators at creation, and offload= places optimizer state
+in host memory. Reference: fleet/meta_parallel/sharding/group_sharded_*."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.utils import unique_name
+
+N = 8  # sharding degree = full virtual mesh
+
+
+def _init_fleet():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs["dp_degree"] = 1
+    strategy.hybrid_configs["sharding_degree"] = N
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _mlp():
+    with unique_name.guard():
+        paddle.seed(0)
+        return paddle.nn.Sequential(
+            paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 16)
+        )
+
+
+def _shard_bytes(arr):
+    return arr.addressable_shards[0].data.nbytes
+
+
+def _total_bytes(arr):
+    return arr.nbytes
+
+
+def test_stage1_accumulators_sharded_at_creation():
+    _init_fleet()
+    net = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level="os")
+
+    x = Tensor(np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    loss = model(x).square().mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+    checked = 0
+    for store in opt._accumulators.values():
+        for acc in store.values():
+            if hasattr(acc, "ndim") and acc.ndim >= 1 and acc.shape[0] % N == 0:
+                assert _shard_bytes(acc) == _total_bytes(acc) // N, acc.shape
+                checked += 1
+    assert checked >= 4  # moment1/moment2 for both weights at least
+
+
+def test_stage2_grads_sharded_at_production():
+    _init_fleet()
+    net = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level="os_g")
+
+    x = Tensor(np.random.RandomState(1).randn(8, 16).astype(np.float32))
+    loss = model(x).square().mean()
+    loss.backward()
+    # BEFORE any optimizer step: grads already sharded 1/N
+    checked = 0
+    for p in model.parameters():
+        if p.grad is not None and p.shape[0] % N == 0:
+            g = p.grad._value
+            assert _shard_bytes(g) == _total_bytes(g) // N, p.name
+            checked += 1
+    assert checked >= 2
+
+
+def test_stage3_params_sharded_at_rest():
+    _init_fleet()
+    net = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level="p_g_os")
+    checked = 0
+    for p in model.parameters():
+        if p.shape[0] % N == 0:
+            assert _shard_bytes(p._value) == _total_bytes(p._value) // N
+            checked += 1
+    assert checked >= 2
+    # and the model still runs + trains
+    x = Tensor(np.random.RandomState(2).randn(8, 16).astype(np.float32))
+    loss = model(x).square().mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    assert np.isfinite(float(np.asarray(loss._value)))
+
+
+def test_sharding_survives_jitted_step():
+    """Inside a CompiledStep the sharding constraints hold: post-step
+    accumulators and grads-in-trace stay 1/N."""
+    from paddle_tpu.jit.functionalize import CompiledStep
+
+    _init_fleet()
+    net = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level="os_g")
+
+    def step(x):
+        loss = model(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cs = CompiledStep(step, stateful=[model, opt._inner_opt], donate_state=False)
+    x = Tensor(np.random.RandomState(3).randn(8, 16).astype(np.float32))
+    l0 = float(np.asarray(cs(x)._value))
+    l1 = float(np.asarray(cs(x)._value))
+    assert l1 < l0
+    for store in opt._accumulators.values():
+        for acc in store.values():
+            if hasattr(acc, "ndim") and acc.ndim >= 1 and acc.shape[0] % N == 0:
+                assert _shard_bytes(acc) == _total_bytes(acc) // N
+
+
+def test_offload_places_optimizer_state_on_host():
+    _init_fleet()
+    net = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    model, opt, _ = group_sharded_parallel(net, opt, level="os_g",
+                                           offload=True)
+    x = Tensor(np.random.RandomState(4).randn(8, 16).astype(np.float32))
+    loss = model(x).square().mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    kinds = set()
+    for store in opt._accumulators.values():
+        for acc in store.values():
+            if hasattr(acc, "sharding"):
+                kinds.add(getattr(acc.sharding, "memory_kind", None))
+    if "pinned_host" not in kinds:
+        pytest.skip(f"backend has no host memory space (kinds={kinds})")
+
+
+def test_stage2_parity_with_unsharded():
+    """Sharded placement must not change the math."""
+    _init_fleet()
+
+    def run(level):
+        net = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        if level:
+            net, opt, _ = group_sharded_parallel(net, opt, level=level)
+        losses = []
+        x = Tensor(np.random.RandomState(5).randn(8, 16).astype(np.float32))
+        for _ in range(5):
+            loss = net(x).square().mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(np.asarray(loss._value)))
+        return losses
+
+    base = run(None)
+    for level in ("os", "os_g", "p_g_os"):
+        np.testing.assert_allclose(run(level), base, rtol=1e-5,
+                                   err_msg=f"level={level}")
