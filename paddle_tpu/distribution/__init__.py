@@ -1,0 +1,39 @@
+"""paddle.distribution (reference ``python/paddle/distribution/``).
+
+Distribution base + Normal/Uniform/Categorical/Beta/Dirichlet/Multinomial,
+Independent & TransformedDistribution, the transform library, and
+kl_divergence with a register_kl dispatch table — the same public surface,
+built on jax.random sampling (keys from the global generator, so sampling is
+jit-traceable and reproducible under paddle.seed) and Tensor-op math (so
+log_prob/entropy are differentiable through the tape).
+"""
+from .distribution import Distribution  # noqa: F401
+from .normal import Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .multinomial import Multinomial  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from . import transform  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    TanhTransform,
+    Transform,
+)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Beta", "Dirichlet",
+    "Multinomial", "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl", "Transform", "AbsTransform",
+    "AffineTransform", "ChainTransform", "ExpTransform", "PowerTransform",
+    "SigmoidTransform", "SoftmaxTransform", "TanhTransform",
+]

@@ -1,0 +1,63 @@
+"""Beta distribution (reference ``distribution/beta.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Beta"]
+
+
+def _betaln(a, b):
+    from jax.scipy.special import betaln
+
+    return betaln(a, b)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_tensor(alpha)
+        self.beta = _as_tensor(beta)
+        shape = jnp.broadcast_shapes(self.alpha._value.shape,
+                                     self.beta._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def fwd(a, b):
+            return jax.random.beta(rnd.next_key(), a, b, out_shape)
+
+        return apply_op("beta_sample", fwd, (self.alpha, self.beta), {}).detach()
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+
+        def fwd(v, a, b):
+            return ((a - 1.0) * jnp.log(v) + (b - 1.0) * jnp.log1p(-v)
+                    - _betaln(a, b))
+
+        return apply_op("beta_log_prob", fwd,
+                        (value, self.alpha, self.beta), {})
+
+    def entropy(self):
+        def fwd(a, b):
+            from jax.scipy.special import digamma
+
+            s = a + b
+            return (_betaln(a, b) - (a - 1.0) * digamma(a)
+                    - (b - 1.0) * digamma(b) + (s - 2.0) * digamma(s))
+
+        return apply_op("beta_entropy", fwd, (self.alpha, self.beta), {})

@@ -1,0 +1,63 @@
+"""Categorical distribution (reference ``distribution/categorical.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Categorical"]
+
+
+class Categorical(Distribution):
+    """Parameterized by unnormalized ``logits`` (reference accepts logits;
+    values are normalized internally)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+        shape = self.logits._value.shape
+        super().__init__(batch_shape=shape[:-1])
+        self._n = shape[-1]
+
+    @property
+    def _log_p(self):
+        from ..nn.functional.activation import log_softmax
+
+        return log_softmax(self.logits, -1)
+
+    @property
+    def _p(self):
+        from ..nn.functional.activation import softmax
+
+        return softmax(self.logits, -1)
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+
+        def fwd(logits):
+            return jax.random.categorical(
+                rnd.next_key(), logits, axis=-1,
+                shape=out_shape,
+            ).astype(jnp.int32)
+
+        out = apply_op("categorical_sample", fwd, (self.logits,), {})
+        return out.detach()
+
+    def log_prob(self, value):
+        from ..nn.functional.common import one_hot
+
+        value = _as_tensor(value)
+        idx = value.astype("int32")
+        logp = self._log_p
+        onehot = one_hot(idx, self._n).astype("float32")
+        return (logp * onehot).sum(axis=-1)
+
+    def probs(self, value):
+        return self.log_prob(value).exp()
+
+    def entropy(self):
+        p, logp = self._p, self._log_p
+        return -(p * logp).sum(axis=-1)

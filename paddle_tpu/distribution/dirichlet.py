@@ -1,0 +1,60 @@
+"""Dirichlet distribution (reference ``distribution/dirichlet.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Dirichlet"]
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _as_tensor(concentration)
+        shape = self.concentration._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1, keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+
+        def fwd(conc):
+            return jax.random.dirichlet(rnd.next_key(), conc, out_shape)
+
+        return apply_op("dirichlet_sample", fwd, (self.concentration,), {}).detach()
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+
+        def fwd(v, conc):
+            from jax.scipy.special import gammaln
+
+            lognorm = jnp.sum(gammaln(conc), -1) - gammaln(jnp.sum(conc, -1))
+            return jnp.sum((conc - 1.0) * jnp.log(v), -1) - lognorm
+
+        return apply_op("dirichlet_log_prob", fwd,
+                        (value, self.concentration), {})
+
+    def entropy(self):
+        def fwd(conc):
+            from jax.scipy.special import digamma, gammaln
+
+            k = conc.shape[-1]
+            a0 = jnp.sum(conc, -1)
+            lognorm = jnp.sum(gammaln(conc), -1) - gammaln(a0)
+            return (lognorm + (a0 - k) * digamma(a0)
+                    - jnp.sum((conc - 1.0) * digamma(conc), -1))
+
+        return apply_op("dirichlet_entropy", fwd, (self.concentration,), {})

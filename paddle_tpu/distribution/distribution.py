@@ -1,0 +1,73 @@
+"""Distribution base (reference ``distribution/distribution.py``)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _as_tensor(x, dtype=jnp.float32):
+    """Thin alias over the dispatcher's ensure_tensor (single conversion
+    path) with a float32 default for distribution parameters."""
+    from ..ops.dispatch import ensure_tensor
+
+    if isinstance(x, Tensor):
+        return x
+    return ensure_tensor(x, dtype)
+
+
+class Distribution:
+    """Reference ``distribution.py Distribution``: batch_shape/event_shape,
+    sample/rsample/log_prob/prob/entropy surface."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_shape={self.batch_shape}, event_shape={self.event_shape})"

@@ -1,0 +1,46 @@
+"""Independent (reference ``distribution/independent.py``): reinterprets
+batch dims as event dims (log_prob sums over them)."""
+from __future__ import annotations
+
+from .distribution import Distribution
+
+__all__ = ["Independent"]
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if not (0 < reinterpreted_batch_rank <= len(base.batch_shape)):
+            raise ValueError(
+                "reinterpreted_batch_rank must be in (0, len(batch_shape)]")
+        self._base = base
+        self._rank = reinterpreted_batch_rank
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cut = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:cut],
+                         event_shape=shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self._base.log_prob(value)
+        for _ in range(self._rank):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        ent = self._base.entropy()
+        for _ in range(self._rank):
+            ent = ent.sum(axis=-1)
+        return ent

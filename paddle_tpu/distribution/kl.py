@@ -1,0 +1,103 @@
+"""KL divergence with a register_kl dispatch table (reference
+``distribution/kl.py``: ``kl_divergence``, ``register_kl``)."""
+from __future__ import annotations
+
+import functools
+
+from ..ops.dispatch import apply_op
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_TABLE = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL rule (reference ``kl.py``)."""
+
+    def deco(fn):
+        _KL_TABLE[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _dispatch(p, q):
+    matches = [
+        (cp, cq) for (cp, cq) in _KL_TABLE
+        if isinstance(p, cp) and isinstance(q, cq)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, {type(q).__name__})"
+        )
+    # most-derived match (reference picks the closest ancestors)
+    matches.sort(key=lambda cc: (len(type(p).__mro__) - type(p).__mro__.index(cc[0]),
+                                 len(type(q).__mro__) - type(q).__mro__.index(cc[1])),
+                 reverse=True)
+    return _KL_TABLE[matches[0]]
+
+
+def kl_divergence(p, q):
+    return _dispatch(p, q)(p, q)
+
+
+# ---------------------------------------------------------------------------
+# built-in rules
+# ---------------------------------------------------------------------------
+
+from .beta import Beta  # noqa: E402
+from .categorical import Categorical  # noqa: E402
+from .dirichlet import Dirichlet  # noqa: E402
+from .normal import Normal  # noqa: E402
+from .uniform import Uniform  # noqa: E402
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale)
+    var_ratio = var_ratio * var_ratio
+    t1 = (p.loc - q.loc) / q.scale
+    t1 = t1 * t1
+    return 0.5 * (var_ratio + t1 - 1.0 - var_ratio.log())
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp = p._p
+    return (pp * (p._log_p - q._log_p)).sum(axis=-1)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # KL is finite only when supp(p) ⊆ supp(q); standard formula
+    return ((q.high - q.low) / (p.high - p.low)).log()
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def fwd(pa, pb, qa, qb):
+        import jax.numpy as jnp
+        from jax.scipy.special import betaln, digamma
+
+        ps = pa + pb
+        return (betaln(qa, qb) - betaln(pa, pb)
+                + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                + (qa - pa + qb - pb) * digamma(ps))
+
+    return apply_op("kl_beta_beta", fwd, (p.alpha, p.beta, q.alpha, q.beta), {})
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def fwd(pc, qc):
+        import jax.numpy as jnp
+        from jax.scipy.special import digamma, gammaln
+
+        p0 = jnp.sum(pc, -1)
+        q0 = jnp.sum(qc, -1)
+        return (gammaln(p0) - gammaln(q0)
+                - jnp.sum(gammaln(pc), -1) + jnp.sum(gammaln(qc), -1)
+                + jnp.sum((pc - qc) * (digamma(pc) - digamma(p0)[..., None]), -1))
+
+    return apply_op("kl_dirichlet_dirichlet", fwd,
+                    (p.concentration, q.concentration), {})

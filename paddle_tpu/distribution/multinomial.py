@@ -1,0 +1,79 @@
+"""Multinomial distribution (reference ``distribution/multinomial.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Multinomial"]
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        if total_count < 1:
+            raise ValueError("total_count should be >= 1")
+        self.total_count = int(total_count)
+        p = _as_tensor(probs)
+        self.probs = p / p.sum(axis=-1, keepdim=True)
+        shape = self.probs._value.shape
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs) * float(self.total_count)
+
+    def sample(self, shape=()):
+        n = self.total_count
+        out_batch = tuple(shape) + self._batch_shape
+
+        def fwd(p):
+            logits = jnp.log(p)
+            draws = jax.random.categorical(
+                rnd.next_key(), logits, axis=-1,
+                shape=(n,) + out_batch,
+            )
+            onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=jnp.float32)
+            return jnp.sum(onehot, axis=0)
+
+        return apply_op("multinomial_sample", fwd, (self.probs,), {}).detach()
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+
+        def fwd(v, p):
+            from jax.scipy.special import gammaln
+
+            return (gammaln(jnp.sum(v, -1) + 1.0)
+                    - jnp.sum(gammaln(v + 1.0), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+
+        return apply_op("multinomial_log_prob", fwd, (value, self.probs), {})
+
+    def entropy(self):
+        """Exact entropy (reference ``multinomial.py:154``):
+        ``n*H(cat) - lgamma(n+1) + sum_k sum_j Binom(n, p_j).pmf(k) *
+        lgamma(k+1)``."""
+        n = self.total_count
+
+        def fwd(p):
+            from jax.scipy.special import gammaln
+
+            nf = jnp.float32(n)
+            ks = jnp.arange(1, n + 1, dtype=jnp.float32)
+            kcol = ks.reshape((-1,) + (1,) * p.ndim)
+            logc = (gammaln(nf + 1.0) - gammaln(kcol + 1.0)
+                    - gammaln(nf - kcol + 1.0))
+            logpmf = (logc + kcol * jnp.log(p)
+                      + (nf - kcol) * jnp.log1p(-p))
+            cat_ent = -jnp.sum(p * jnp.log(p), -1)
+            corr = jnp.sum(jnp.exp(logpmf) * gammaln(kcol + 1.0), axis=(0, -1))
+            return nf * cat_ent - gammaln(nf + 1.0) + corr
+
+        return apply_op("multinomial_entropy", fwd, (self.probs,), {})

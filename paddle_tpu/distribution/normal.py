@@ -1,0 +1,62 @@
+"""Normal distribution (reference ``distribution/normal.py``)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Normal"]
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        shape = jnp.broadcast_shapes(self.loc._value.shape,
+                                     self.scale._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return self.loc.broadcast_to(self.batch_shape) if self.batch_shape else self.loc
+
+    @property
+    def variance(self):
+        return (self.scale * self.scale).broadcast_to(self.batch_shape) \
+            if self.batch_shape else self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale.broadcast_to(self.batch_shape) if self.batch_shape else self.scale
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def fwd(loc, scale):
+            eps = jax.random.normal(rnd.next_key(), out_shape, jnp.float32)
+            return loc + scale * eps  # reparameterized
+
+        return apply_op("normal_rsample", fwd, (self.loc, self.scale), {})
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        var = self.scale * self.scale
+        return (
+            -((value - self.loc) * (value - self.loc)) / (var * 2.0)
+            - self.scale.log()
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        half_log_2pi_e = 0.5 * math.log(2 * math.pi * math.e)
+        ent = self.scale.log() + half_log_2pi_e
+        return ent.broadcast_to(self.batch_shape) if self.batch_shape else ent

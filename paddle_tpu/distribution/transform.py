@@ -1,0 +1,162 @@
+"""Bijective transforms (reference ``distribution/transform.py``)."""
+from __future__ import annotations
+
+import math
+
+from ..framework.tensor import Tensor
+from .distribution import _as_tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "TanhTransform",
+]
+
+
+class Transform:
+    """Reference ``transform.py Transform``: forward/inverse +
+    forward_log_det_jacobian."""
+
+    _type = "bijection"
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _as_tensor(x).exp()
+
+    def inverse(self, y):
+        return _as_tensor(y).log()
+
+    def forward_log_det_jacobian(self, x):
+        return _as_tensor(x)
+
+
+class AbsTransform(Transform):
+    _type = "surjection"
+
+    def forward(self, x):
+        return _as_tensor(x).abs()
+
+    def inverse(self, y):
+        return _as_tensor(y)  # principal branch
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("AbsTransform is not injective")
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * _as_tensor(x)
+
+    def inverse(self, y):
+        return (_as_tensor(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        return self.scale.abs().log().broadcast_to(x.shape) \
+            if list(self.scale.shape) != list(x.shape) else self.scale.abs().log()
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def forward(self, x):
+        return _as_tensor(x) ** self.power
+
+    def inverse(self, y):
+        return _as_tensor(y) ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        return (self.power * x ** (self.power - 1.0)).abs().log()
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        from ..nn.functional.activation import sigmoid
+
+        return sigmoid(_as_tensor(x))
+
+    def inverse(self, y):
+        y = _as_tensor(y)
+        return (y / (1.0 - y)).log()
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional.activation import softplus
+
+        x = _as_tensor(x)
+        return -softplus(-x) - softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _as_tensor(x).tanh()
+
+    def inverse(self, y):
+        y = _as_tensor(y)
+        return 0.5 * ((1.0 + y) / (1.0 - y)).log()
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional.activation import softplus
+
+        x = _as_tensor(x)
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - softplus(x * -2.0))
+
+
+class SoftmaxTransform(Transform):
+    _type = "other"
+
+    def forward(self, x):
+        from ..nn.functional.activation import softmax
+
+        return softmax(_as_tensor(x), -1)
+
+    def inverse(self, y):
+        return _as_tensor(y).log()
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not a bijection")
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total

@@ -1,0 +1,40 @@
+"""TransformedDistribution (reference
+``distribution/transformed_distribution.py``)."""
+from __future__ import annotations
+
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self._base = base
+        self._transforms = list(transforms)
+        super().__init__(batch_shape=base.batch_shape,
+                         event_shape=base.event_shape)
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        log_det = None
+        y = value
+        # walk transforms backward, accumulating inverse log-dets
+        for t in reversed(self._transforms):
+            x = t.inverse(y)
+            j = t.forward_log_det_jacobian(x)
+            log_det = j if log_det is None else log_det + j
+            y = x
+        lp = self._base.log_prob(y)
+        return lp - log_det if log_det is not None else lp

@@ -1,0 +1,53 @@
+"""Uniform distribution (reference ``distribution/uniform.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rnd
+from ..ops.dispatch import apply_op
+from .distribution import Distribution, _as_tensor
+
+__all__ = ["Uniform"]
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+        shape = jnp.broadcast_shapes(self.low._value.shape,
+                                     self.high._value.shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+
+        def fwd(low, high):
+            u = jax.random.uniform(rnd.next_key(), out_shape, jnp.float32)
+            return low + (high - low) * u
+
+        return apply_op("uniform_rsample", fwd, (self.low, self.high), {})
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        from .. import ops
+
+        inside = (value >= self.low).astype("float32") * \
+                 (value < self.high).astype("float32")
+        dens = inside / (self.high - self.low)
+        return ops.log(dens)  # log(0) = -inf outside the support
+
+    def entropy(self):
+        return (self.high - self.low).log()

@@ -241,16 +241,25 @@ class Tensor:
         return self
 
     def to(self, *args, **kwargs):
+        """Tensor.to(dtype) / to(device[, dtype]) — unknown arguments raise
+        (the reference's enforce discipline; silent drops hid user typos)."""
         t = self
+        blocking = kwargs.pop("blocking", None)  # accepted, XLA is async
+        _places = ("cpu", "tpu", "gpu", "xpu", "npu", "mlu", "ipu",
+                   "gpu_pinned")
         for a in list(args) + list(kwargs.values()):
-            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+            if isinstance(a, str) and a.split(":", 1)[0] in _places:
                 continue
-            if isinstance(a, Place):
+            if isinstance(a, Place) or a is None or isinstance(a, bool):
                 continue
             try:
-                t = t.astype(dtypes.convert_dtype(a))
+                dt = dtypes.convert_dtype(a)
             except (ValueError, TypeError):
-                pass
+                raise ValueError(
+                    f"Tensor.to(): unrecognized argument {a!r} (expected a "
+                    "dtype, a place string like 'cpu'/'gpu:0', or a Place)"
+                )
+            t = t.astype(dt)
         return t
 
     def pin_memory(self):

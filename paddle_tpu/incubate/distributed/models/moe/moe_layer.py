@@ -81,6 +81,12 @@ class MoELayer(Layer):
         if capacity_factor is not None:
             self.gate.capacity_factor = float(capacity_factor)
         self.moe_group = moe_group if moe_group is not None else self._default_group()
+        # expert-parallel sharding needs the expert count to tile the group
+        # axis; otherwise run dense/replicated (the reference requires
+        # num_experts % world_size == 0 — here it degrades gracefully)
+        if (self.moe_group is not None
+                and self.num_experts % self.moe_group.nranks != 0):
+            self.moe_group = None
         self.aux_loss = None
 
         # stack expert params (template apply pattern, like the pipeline)
@@ -123,7 +129,10 @@ class MoELayer(Layer):
     def forward(self, x):
         orig_shape = list(x.shape)
         d = orig_shape[-1]
-        tokens = int(jnp.prod(jnp.asarray(orig_shape[:-1]))) if len(orig_shape) > 1 else 1
+        # static python math: shapes are ints; jnp here would break jit tracing
+        tokens = 1
+        for s in orig_shape[:-1]:
+            tokens *= int(s)
         x2 = x.reshape([-1, d])
         capacity = self.gate.capacity(tokens, k=self.gate.top_k)
 

@@ -24,8 +24,9 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
     """Pallas flash path: TPU (or interpret-mode) backend, MXU-tileable
     sequence lengths, no attention dropout (dropout needs the probs), and —
     when a mask is given — a mask the kernel streams exactly: trailing dims
-    ``(sq, sk)`` with broadcastable batch/head dims, and not a trainable bias
-    (the fused backward does not produce a mask gradient)."""
+    ``(sq, sk)`` with broadcastable batch/head dims. Trainable biases are
+    supported: the fused backward computes the real dS-sum bias gradient
+    (XLA-DCE'd when unused)."""
     from ...framework.flags import flag_value
     from ...ops import pallas
 
@@ -42,8 +43,6 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
     if sq * sk < flag_value("flash_attention_min_seq_prod") and not pallas.interpret_requested():
         return False
     if mask is not None:
-        if getattr(mask, "stop_gradient", True) is False:
-            return False  # learned bias: einsum path computes its gradient
         ms = tuple(mask.shape)
         if len(ms) == 4:
             if ms[2:] != (sq, sk):
@@ -60,11 +59,13 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
 
 
 @op("flash_sdpa")
-def _sdpa_flash(q, k, v, mask=None, causal=False, scale=None):
+def _sdpa_flash(q, k, v, mask=None, causal=False, scale=None,
+                mask_trainable=False):
     """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
     from ...ops.pallas.flash_attention import flash_attention as fa
 
-    return fa(q, k, v, bias=mask, causal=causal, scale=scale)
+    return fa(q, k, v, bias=mask, causal=causal, scale=scale,
+              bias_grad=mask_trainable)
 
 
 @op("sdpa")
@@ -96,8 +97,10 @@ def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
 def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
           training=True, scale=None):
     if _flash_ok(query.shape, key.shape, attn_mask, dropout_p, training):
+        trainable = (attn_mask is not None
+                     and getattr(attn_mask, "stop_gradient", True) is False)
         return _sdpa_flash(query, key, value, attn_mask, causal=is_causal,
-                           scale=scale)
+                           scale=scale, mask_trainable=trainable)
     dropout_mask = None
     if dropout_p > 0.0 and training:
         b, sq, h, _ = query.shape

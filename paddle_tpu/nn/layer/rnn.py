@@ -1,0 +1,472 @@
+"""Recurrent layers: SimpleRNN/LSTM/GRU (+ cells, RNN/BiRNN wrappers).
+
+Reference: ``python/paddle/nn/layer/rnn.py:1`` (SimpleRNNCell:270,
+LSTMCell:406, GRUCell:563, RNN:714, BiRNN:789, RNNBase:868). Cell equations
+match the reference exactly (LSTM gate order i,f,g,o; GRU
+``h = (h_prev - c) * z + c`` with reset applied after the h-matmul).
+
+TPU-native design: the time loop is ONE ``lax.scan`` op per (layer,
+direction) — compiled to a single fused XLA while-loop on the device rather
+than the reference's per-timestep op dispatch (or cudnn descriptor calls).
+``sequence_length`` masking gates state updates inside the scan, so padded
+steps pass state through and emit zeros, in both directions.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op, op
+from ..initializer import Uniform
+from .layers import Layer, ParamAttr
+from .container import LayerList
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu}[name]
+
+
+# ---------------------------------------------------------------------------
+# single-step cell math (shared by the cells' forward and the fused scan)
+# ---------------------------------------------------------------------------
+
+def _simple_step(x_t, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    g = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    h = _act(activation)(g)
+    return h, (h,)
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return h, (h, c)
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x_t @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)   # reset gate applied after the matmul
+    h = (h - c) * z + c
+    return h, (h,)
+
+
+@op("rnn_scan")
+def _rnn_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len=None, mode="RNN_TANH",
+              reverse=False, time_major=False):
+    """One (layer, direction) recurrent sweep as a single lax.scan.
+
+    x: [B, T, I] (or [T, B, I] when time_major). h0/c0: [B, H] (c0 only for
+    LSTM). seq_len: optional [B] int lengths — padded steps pass state
+    through and write zero outputs. Returns (outputs, h_n[, c_n]).
+    """
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)          # -> [T, B, I]
+    T = x.shape[0]
+    ts = jnp.arange(T)
+    if reverse:
+        x = jnp.flip(x, axis=0)
+        ts = jnp.flip(ts, axis=0)
+
+    lstm = mode == "LSTM"
+
+    def step(carry, inp):
+        t, x_t = inp
+        if lstm:
+            h, c = carry
+            out, (h_new, c_new) = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+        elif mode == "GRU":
+            (h,) = carry
+            out, (h_new,) = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+        else:
+            (h,) = carry
+            act = "relu" if mode == "RNN_RELU" else "tanh"
+            out, (h_new,) = _simple_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act)
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            out = jnp.where(valid, out, 0.0)
+            h_new = jnp.where(valid, h_new, carry[0])
+            if lstm:
+                c_new = jnp.where(valid, c_new, carry[1])
+        new_carry = (h_new, c_new) if lstm else (h_new,)
+        return new_carry, out
+
+    init = (h0, c0) if lstm else (h0,)
+    final, outs = lax.scan(step, init, (ts, x))
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    if lstm:
+        return outs, final[0], final[1]
+    return outs, final[0]
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    """Reference ``rnn.py:143``."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import ops
+
+        batch = batch_ref.shape[batch_dim_idx]
+        shapes = shape or self.state_shape
+        if isinstance(shapes[0], (tuple, list)):
+            return tuple(
+                ops.full([batch] + list(s), init_value,
+                         dtype or "float32") for s in shapes
+            )
+        return ops.full([batch] + list(shapes), init_value, dtype or "float32")
+
+
+class _GateCell(RNNCellBase):
+    """Shared parameter layout: weight_ih [G*H, I], weight_hh [G*H, H]."""
+
+    GATES = 1
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = ParamAttr(initializer=Uniform(-std, std))
+        g = self.GATES
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], attr=weight_ih_attr or init)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], attr=weight_hh_attr or init)
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], attr=bias_ih_attr or init, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], attr=bias_hh_attr or init, is_bias=True)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class SimpleRNNCell(_GateCell):
+    """Reference ``rnn.py:270``: h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, **kw)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    @property
+    def MODE(self):
+        return "RNN_RELU" if self.activation == "relu" else "RNN_TANH"
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fwd(x_t, h, w_ih, w_hh, b_ih, b_hh):
+            out, (h2,) = _simple_step(x_t, h, w_ih, w_hh, b_ih, b_hh,
+                                      self.activation)
+            return out, h2
+
+        out, h = apply_op("simple_rnn_cell", fwd,
+                          (inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), {})
+        return out, h
+
+
+class LSTMCell(_GateCell):
+    """Reference ``rnn.py:406``: gates i,f,g,o."""
+
+    GATES = 4
+    MODE = "LSTM"
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def fwd(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+            out, (h2, c2) = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+            return out, h2, c2
+
+        out, h2, c2 = apply_op("lstm_cell", fwd,
+                               (inputs, h, c, self.weight_ih, self.weight_hh,
+                                self.bias_ih, self.bias_hh), {})
+        return out, (h2, c2)
+
+
+class GRUCell(_GateCell):
+    """Reference ``rnn.py:563``: r,z,c with reset applied after the matmul."""
+
+    GATES = 3
+    MODE = "GRU"
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fwd(x_t, h, w_ih, w_hh, b_ih, b_hh):
+            out, (h2,) = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+            return out, h2
+
+        out, h = apply_op("gru_cell", fwd,
+                          (inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), {})
+        return out, h
+
+
+# ---------------------------------------------------------------------------
+# wrappers
+# ---------------------------------------------------------------------------
+
+def _run_cell_scan(cell, inputs, initial_states, sequence_length,
+                   is_reverse, time_major):
+    """Fused lax.scan sweep for the builtin cells."""
+    lstm = cell.MODE == "LSTM"
+    if initial_states is None:
+        batch_idx = 1 if time_major else 0
+        ref = inputs
+        initial_states = cell.get_initial_states(ref, batch_dim_idx=batch_idx)
+    if lstm:
+        h0, c0 = initial_states
+    else:
+        h0 = initial_states
+        if isinstance(h0, (tuple, list)):
+            h0 = h0[0]
+        c0 = None
+
+    out = _rnn_scan(
+        inputs, h0, c0 if lstm else None,
+        cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh,
+        sequence_length,
+        mode=cell.MODE, reverse=bool(is_reverse), time_major=bool(time_major),
+    )
+    if lstm:
+        outs, h_n, c_n = out
+        return outs, (h_n, c_n)
+    outs, h_n = out
+    return outs, h_n
+
+
+class RNN(Layer):
+    """Reference ``rnn.py:714``: wrap a cell into a time-sweep. Builtin cells
+    run as one fused scan; custom cells fall back to a python time loop."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        if isinstance(self.cell, _GateCell):
+            return _run_cell_scan(self.cell, inputs, initial_states,
+                                  sequence_length, self.is_reverse,
+                                  self.time_major)
+        return self._python_loop(inputs, initial_states, sequence_length,
+                                 **kwargs)
+
+    def _python_loop(self, inputs, initial_states, sequence_length, **kwargs):
+        from ... import ops
+
+        t_axis = 0 if self.time_major else 1
+        T = inputs.shape[t_axis]
+        states = initial_states
+        if states is None:
+            states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0)
+        outs = []
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in order:
+            x_t = (inputs[t] if self.time_major else inputs[:, t])
+            out, new_states = self.cell(x_t, states, **kwargs)
+            if sequence_length is not None:
+                # same masking the fused scan applies: padded steps emit
+                # zeros and pass the state through
+                valid = (sequence_length > t).astype(out.dtype).unsqueeze(-1)
+                out = out * valid
+                if isinstance(new_states, (tuple, list)):
+                    new_states = tuple(
+                        ns * valid + s * (1.0 - valid)
+                        for ns, s in zip(new_states, states)
+                    )
+                else:
+                    new_states = new_states * valid + states * (1.0 - valid)
+            states = new_states
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = ops.stack(outs, axis=t_axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    """Reference ``rnn.py:789``: forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ... import ops
+
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_fw, s_fw = self._fw(inputs, st_fw, sequence_length, **kwargs)
+        out_bw, s_bw = self._bw(inputs, st_bw, sequence_length, **kwargs)
+        outputs = ops.concat([out_fw, out_bw], axis=-1)
+        return outputs, (s_fw, s_bw)
+
+
+class RNNBase(Layer):
+    """Reference ``rnn.py:868``: multi-layer, (bi)directional stacks."""
+
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **cell_kwargs):
+        super().__init__()
+        if direction in ("bidirectional", "bidirect"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(
+                f"direction should be forward or bidirect(ional), got {direction}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.direction = direction
+
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+            for _ in range(self.num_directions):
+                cells.append(self.CELL(in_sz, hidden_size, **cell_kwargs))
+        self.cells = LayerList(cells)
+
+    @property
+    def _is_lstm(self):
+        return self.CELL is LSTMCell
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        """Returns (outputs [B,T,H*D], final_states [L*D,B,H] (or tuple of
+        two for LSTM))."""
+        from ... import ops
+
+        L, D = self.num_layers, self.num_directions
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+
+        if initial_states is None:
+            init_h = [None] * (L * D)
+            init_c = [None] * (L * D)
+        elif self._is_lstm:
+            h_all, c_all = initial_states
+            init_h = [h_all[i] for i in range(L * D)]
+            init_c = [c_all[i] for i in range(L * D)]
+        else:
+            init_h = [initial_states[i] for i in range(L * D)]
+            init_c = [None] * (L * D)
+
+        x = inputs
+        final_h, final_c = [], []
+        for layer in range(L):
+            outs_dir = []
+            for d in range(D):
+                idx = layer * D + d
+                cell = self.cells[idx]
+                st = None
+                if init_h[idx] is not None:
+                    st = ((init_h[idx], init_c[idx]) if self._is_lstm
+                          else init_h[idx])
+                outs, st_out = _run_cell_scan(
+                    cell, x, st, sequence_length, is_reverse=(d == 1),
+                    time_major=self.time_major)
+                outs_dir.append(outs)
+                if self._is_lstm:
+                    final_h.append(st_out[0])
+                    final_c.append(st_out[1])
+                else:
+                    final_h.append(st_out)
+            x = outs_dir[0] if D == 1 else ops.concat(outs_dir, axis=-1)
+            if self.dropout > 0.0 and layer < L - 1:
+                from .. import functional as F
+
+                x = F.dropout(x, self.dropout, training=self.training)
+
+        h_n = ops.stack(final_h, axis=0)
+        if self._is_lstm:
+            c_n = ops.stack(final_c, axis=0)
+            return x, (h_n, c_n)
+        return x, h_n
+
+
+class SimpleRNN(RNNBase):
+    """Reference ``rnn.py:1110``."""
+
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation, **kw)
+
+
+class LSTM(RNNBase):
+    """Reference ``rnn.py:1221``."""
+
+    CELL = LSTMCell
+
+
+class GRU(RNNBase):
+    """Reference ``rnn.py:1336``."""
+
+    CELL = GRUCell

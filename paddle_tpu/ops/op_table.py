@@ -1,0 +1,215 @@
+"""Declarative op table — the source of truth for the differentiable-op
+API surface and its gradient-check specs.
+
+Reference: the yaml op registry ``paddle/phi/api/yaml/legacy_api.yaml``
+(+ backward yamls) generating API and grad rules; SURVEY §7 keeps "yaml
+retained as the source of truth". TPU-native form: op *implementations* are
+jax-traced functions (their grad rule IS jax.vjp), so what the table
+declares is the part yaml declared that still matters here — the public
+signature, which inputs are differentiable, the numeric domain each input
+must be drawn from, and the finite-difference tolerances. The OpTest sweep
+(``tests/test_op_grad_sweep.py``) is generated from this table, mirroring
+the reference's per-op ``check_grad`` coverage.
+
+Entry fields:
+    api:     dotted path under the public surface ("ops.tanh", "F.relu",
+             "Tensor.abs" is not used — methods alias the same ops)
+    inputs:  tuple of input specs; each is (shape, domain) where domain is
+             one of f / fp / unit / gt1 / sym / spd / prob / int:<n> / bool
+             (int:/bool inputs are non-differentiable and fixed)
+    kwargs:  static attributes
+    rtol/atol/delta: finite-difference tolerances (defaults 1e-2/1e-3/1e-3)
+    only:    indices of differentiable inputs to check (default: all float)
+"""
+from __future__ import annotations
+
+OPS = []
+
+
+def _op(api, inputs, kwargs=None, rtol=1e-2, atol=1e-3, delta=1e-3,
+        only=None, out_reduce=False):
+    OPS.append(dict(api=api, inputs=inputs, kwargs=kwargs or {},
+                    rtol=rtol, atol=atol, delta=delta, only=only,
+                    out_reduce=out_reduce))
+
+
+S = (3, 4)          # default small shape
+V = (6,)            # vector
+
+# --- elementwise unary: full real domain -----------------------------------
+for name in [
+    "abs", "asinh", "atan", "ceil_like_skip", "cos", "cosh", "erf", "exp",
+    "expm1", "neg", "round_like_skip", "sign_like_skip", "sin", "sinh",
+    "square", "tan", "tanh",
+]:
+    if name.endswith("_skip"):
+        continue
+    _op(f"ops.{name}", ((S, "f"),))
+_op("ops.abs", ((S, "fp"),))            # away from the |x| kink at 0
+_op("ops.atan2", ((S, "fp"), (S, "fp")))
+
+# --- positive / restricted domains ------------------------------------------
+for name in ["log", "log2", "log10", "log1p", "sqrt", "rsqrt", "reciprocal",
+             "digamma", "lgamma"]:
+    _op(f"ops.{name}", ((S, "fp"),))
+_op("ops.acos", ((S, "unit"),))
+_op("ops.asin", ((S, "unit"),))
+_op("ops.atanh", ((S, "unit"),))
+_op("ops.acosh", ((S, "gt1"),))
+_op("ops.logit", ((S, "unit"),), kwargs=dict(eps=0.0))
+_op("ops.erfinv", ((S, "unit"),))
+_op("ops.cumprod", ((V, "fp"),), kwargs=dict(dim=0))
+_op("ops.logsumexp", ((S, "f"),))
+_op("ops.logaddexp", ((S, "f"), (S, "f")))
+
+# --- binary elementwise ------------------------------------------------------
+_op("ops.add", ((S, "f"), (S, "f")))
+_op("ops.subtract", ((S, "f"), (S, "f")))
+_op("ops.multiply", ((S, "f"), (S, "f")))
+_op("ops.divide", ((S, "f"), (S, "fp")))
+_op("ops.maximum", ((S, "f"), (S, "f2")))
+_op("ops.minimum", ((S, "f"), (S, "f2")))
+_op("ops.fmax", ((S, "f"), (S, "f2")))
+_op("ops.fmin", ((S, "f"), (S, "f2")))
+_op("ops.pow", ((S, "fp"), (S, "fp")))
+_op("ops.hypot", ((S, "fp"), (S, "fp")))
+_op("ops.copysign", ((S, "fp"), (S, "fp")), only=(0,))
+_op("ops.lerp", ((S, "f"), (S, "f"), (S, "unit")))
+_op("ops.nextafter", ((S, "f"), (S, "f")), only=())
+
+# --- reductions --------------------------------------------------------------
+_op("ops.sum", ((S, "f"),))
+_op("ops.sum", ((S, "f"),), kwargs=dict(axis=1))
+_op("ops.mean", ((S, "f"),))
+_op("ops.mean", ((S, "f"),), kwargs=dict(axis=0, keepdim=True))
+_op("ops.prod", ((S, "fp"),))
+_op("ops.max", ((S, "funique"),))
+_op("ops.min", ((S, "funique"),))
+_op("ops.amax", ((S, "funique"),))
+_op("ops.nansum", ((S, "f"),))
+_op("ops.nanmean", ((S, "f"),))
+_op("ops.std", ((S, "f"),), rtol=2e-2)
+_op("ops.var", ((S, "f"),), rtol=2e-2)
+_op("ops.trace", ((S, "f"),))
+_op("ops.cumsum", ((S, "f"),), kwargs=dict(axis=1))
+_op("ops.median", ((V, "funique"),), rtol=3e-2)
+_op("ops.quantile", ((V, "funique"),), kwargs=dict(q=0.5), rtol=3e-2)
+
+# --- linalg ------------------------------------------------------------------
+M33 = (3, 3)
+_op("ops.matmul", ((S, "f"), ((4, 5), "f")))
+_op("ops.matmul", ((S, "f"), (S, "f")), kwargs=dict(transpose_y=True))
+_op("ops.bmm", (((2, 3, 4), "f"), ((2, 4, 3), "f")))
+_op("ops.dot", ((V, "f"), (V, "f")))
+_op("ops.mv", ((S, "f"), ((4,), "f")))
+_op("ops.outer", ((V, "f"), ((4,), "f")))
+_op("ops.inner", ((S, "f"), ((5, 4), "f")))
+_op("ops.kron", (((2, 2), "f"), ((2, 2), "f")))
+_op("ops.addmm", ((M33, "f"), (M33, "f"), (M33, "f")))
+_op("ops.inverse", ((M33, "spd"),), rtol=3e-2, atol=5e-3)
+_op("ops.det", ((M33, "spd"),), rtol=3e-2)
+_op("ops.slogdet", ((M33, "spd"),), rtol=3e-2, only=(0,))
+_op("ops.cholesky", ((M33, "spd"),), rtol=3e-2, atol=5e-3)
+_op("ops.solve", ((M33, "spd"), (M33, "f")), rtol=3e-2, atol=5e-3)
+_op("ops.triangular_solve", ((M33, "trilpd"), (M33, "f")),
+    rtol=3e-2, atol=5e-3)
+_op("ops.matrix_power", ((M33, "f"),), kwargs=dict(n=2))
+_op("ops.multi_dot", (((3, 4), "f"), ((4, 2), "f")))
+_op("ops.einsum_ij_jk", (((3, 4), "f"), ((4, 2), "f")))
+_op("ops.pinv", ((M33, "spd"),), rtol=5e-2, atol=1e-2)
+
+# --- manipulation ------------------------------------------------------------
+_op("ops.reshape", ((S, "f"),), kwargs=dict(shape=[4, 3]))
+_op("ops.transpose", ((S, "f"),), kwargs=dict(perm=[1, 0]))
+_op("ops.flatten", (((2, 3, 4), "f"),))
+_op("ops.squeeze", (((3, 1, 4), "f"),), kwargs=dict(axis=1))
+_op("ops.unsqueeze", ((S, "f"),), kwargs=dict(axis=0))
+_op("ops.concat2", ((S, "f"), (S, "f")), kwargs=dict(axis=0))
+_op("ops.stack2", ((S, "f"), (S, "f")), kwargs=dict(axis=0))
+_op("ops.split_first", (((4, 4), "f"),), kwargs=dict(num_or_sections=2))
+_op("ops.tile", ((S, "f"),), kwargs=dict(repeat_times=[2, 1]))
+_op("ops.expand", (((1, 4), "f"),), kwargs=dict(shape=[3, 4]))
+_op("ops.flip", ((S, "f"),), kwargs=dict(axis=[0]))
+_op("ops.roll", ((S, "f"),), kwargs=dict(shifts=1))
+_op("ops.rot90", ((S, "f"),))
+_op("ops.moveaxis", (((2, 3, 4), "f"),), kwargs=dict(source=0, destination=2))
+_op("ops.tril", ((S, "f"),))
+_op("ops.triu", ((S, "f"),))
+_op("ops.diag", ((V, "f"),))
+_op("ops.diagonal", ((M33, "f"),))
+_op("ops.diagflat", ((V, "f"),))
+_op("ops.pad2d", ((S, "f"),), kwargs=dict(pad=[1, 1, 0, 2]))
+_op("ops.gather", ((S, "f"), ((2,), "int:3")), kwargs=dict(axis=0))
+_op("ops.index_select", ((S, "f"), ((2,), "int:3")), kwargs=dict(axis=0))
+_op("ops.take_along_axis", ((S, "f"), ((3, 1), "int:4")), kwargs=dict(axis=1))
+_op("ops.gather_nd", ((S, "f"), ((2, 2), "int:3")))
+_op("ops.masked_fill", ((S, "f"), (S, "bool")), kwargs=dict(value=0.5))
+_op("ops.where3", ((S, "bool"), (S, "f"), (S, "f")))
+_op("ops.clip", ((S, "f"),), kwargs=dict(min=-0.5, max=0.5))
+_op("ops.repeat_interleave", ((V, "f"),), kwargs=dict(repeats=2))
+_op("ops.index_sample", ((S, "f"), ((3, 2), "int:4")))
+_op("ops.getitem_slice", ((S, "f"),))
+_op("ops.multiplex2", ((S, "f"), (S, "f")))
+
+# --- activations (functional) ------------------------------------------------
+for name in ["relu", "relu6", "elu", "selu", "celu", "gelu", "silu",
+             "sigmoid", "softplus", "softsign", "mish", "tanhshrink",
+             "log_sigmoid", "hardswish", "hardsigmoid", "leaky_relu",
+             "hardtanh"]:
+    _op(f"F.{name}", ((S, "fnz"),))
+_op("ops.stanh", ((S, "f"),))
+_op("F.softmax", ((S, "f"),))
+_op("F.log_softmax", ((S, "f"),))
+_op("F.softshrink", ((S, "fnz"),), kwargs=dict(threshold=0.1))
+_op("F.hardshrink", ((S, "fnz"),), kwargs=dict(threshold=0.1))
+_op("F.thresholded_relu", ((S, "fnz"),), kwargs=dict(threshold=0.3))
+_op("F.prelu", ((S, "fnz"), ((1,), "unit")))
+_op("F.glu", (((3, 4), "f"),))
+_op("F.maxout", (((1, 4, 2, 2), "funique"),), kwargs=dict(groups=2))
+_op("F.normalize", ((S, "fp"),))
+
+# --- losses ------------------------------------------------------------------
+_op("F.mse_loss", ((S, "f"), (S, "f")))
+_op("F.l1_loss", ((S, "f"), (S, "f2")))
+_op("F.smooth_l1_loss", ((S, "f"), (S, "f2")), kwargs=dict(delta=0.5))
+_op("F.huber_loss", ((S, "f"), (S, "f2")), kwargs=dict(delta=0.5))
+_op("F.kl_div", ((S, "logunit"), (S, "unit")), only=(0,))
+_op("F.binary_cross_entropy", ((S, "unit"), (S, "unit")), only=(0,))
+_op("F.binary_cross_entropy_with_logits", ((S, "f"), (S, "unit")), only=(0,))
+_op("F.cross_entropy_labels", (((4, 5), "f"), ((4, 1), "int:5")), only=(0,))
+_op("F.nll_loss", (((4, 5), "logunit"), ((4,), "int:5")), only=(0,))
+_op("F.square_error_cost", ((S, "f"), (S, "f2")))
+_op("F.log_loss", ((S, "unit"), (S, "unit")), only=(0,))
+_op("F.margin_ranking_loss", ((V, "f"), (V, "f2"), (V, "sign")), only=(0, 1))
+_op("F.cosine_embedding_loss", (((2, 4), "f"), ((2, 4), "f2"), ((2,), "sign")),
+    only=(0, 1), rtol=2e-2)
+_op("F.triplet_margin_loss", ((S, "f"), (S, "f2"), (S, "f3")), rtol=2e-2)
+_op("F.hinge_embedding_loss", ((S, "fnz"), (S, "sign")), only=(0,))
+_op("F.sigmoid_focal_loss", ((S, "f"), (S, "unit")), only=(0,), rtol=2e-2)
+_op("F.softmax_with_cross_entropy", (((4, 5), "f"), ((4, 1), "int:5")),
+    only=(0,))
+_op("F.fused_linear_cross_entropy", (((6, 4), "f"), ((5, 4), "f"),
+                                     ((6,), "int:5")), only=(0, 1))
+
+# --- nn functional (structured) ---------------------------------------------
+_op("F.linear", (((3, 4), "f"), ((4, 5), "f"), ((5,), "f")))
+_op("F.conv2d", (((1, 2, 5, 5), "f"), ((3, 2, 3, 3), "f")), rtol=2e-2)
+_op("F.conv1d", (((1, 2, 8), "f"), ((3, 2, 3), "f")), rtol=2e-2)
+_op("F.conv2d_transpose", (((1, 2, 4, 4), "f"), ((2, 3, 3, 3), "f")),
+    rtol=2e-2)
+_op("F.avg_pool2d", (((1, 2, 4, 4), "f"),), kwargs=dict(kernel_size=2))
+_op("F.max_pool2d", (((1, 2, 4, 4), "funique"),), kwargs=dict(kernel_size=2))
+_op("F.adaptive_avg_pool2d", (((1, 2, 4, 4), "f"),), kwargs=dict(output_size=2))
+_op("F.layer_norm_w", (((3, 4), "f"), ((4,), "fp"), ((4,), "f")), rtol=2e-2)
+_op("F.embedding", (((3,), "int:5"), ((5, 4), "f")), only=(1,))
+_op("F.dropout_eval", ((S, "f"),))
+_op("F.unfold", (((1, 2, 4, 4), "f"),), kwargs=dict(kernel_sizes=2))
+_op("F.interpolate_nearest", (((1, 2, 4, 4), "f"),), only=(0,))
+_op("F.pixel_shuffle", (((1, 4, 2, 2), "f"),), kwargs=dict(upscale_factor=2))
+_op("F.grid_sample", (((1, 1, 4, 4), "f"), ((1, 2, 2, 2), "unit")),
+    rtol=3e-2, atol=5e-3)
+_op("F.scaled_dot_product_attention",
+    (((1, 4, 2, 4), "f"), ((1, 4, 2, 4), "f2"), ((1, 4, 2, 4), "f3")),
+    kwargs=dict(training=False), rtol=2e-2)
+
+OPS = [e for e in OPS if e]

@@ -27,6 +27,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -228,8 +229,9 @@ def _check_shapes(q, k, v, bias):
     return b, h, sq, sk, d
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret,
+           need_dbias):
     # primal path (inference / no grad): skip the logsumexp output entirely
     return _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
                            interpret, need_stats=False)
@@ -295,13 +297,14 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
     return result
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret,
+               need_dbias):
     out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
                                interpret, need_stats=True)
     return out, (q, k, v, bias, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
     q, k, v, bias, out, lse = res
     b, h, sq, sk, d = _check_shapes(q, k, v, bias)
     nq, nk = sq // block_q, sk // block_k
@@ -382,7 +385,35 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
         interpret=interpret,
     )(*[x for x in (q, k, v, bias, g, out, lse) if x is not None])
 
-    dbias = None if bias is None else jnp.zeros_like(bias)
+    if bias is None:
+        dbias = None
+    elif not need_dbias:
+        # constant mask (the common case): a symbolic-zero-like cheap
+        # cotangent; no score matrix is ever materialized
+        dbias = jnp.zeros_like(bias)
+    else:
+        # Real bias gradient: dS = P ⊙ (dO·Vᵀ − rowsum(dO⊙O)), reduced onto
+        # the bias's broadcast shape. Computed with XLA ops from the saved
+        # residuals — this materializes the [b,h,sq,sk] score block, the
+        # unavoidable cost of a trainable dense bias (requested explicitly
+        # via need_dbias; under jit, XLA additionally DCEs it when the
+        # cotangent goes unused).
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        s = s + bias
+        if causal:
+            rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+            s = jnp.where((rows + offset >= cols)[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., 0:1])
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g, v,
+                        preferred_element_type=jnp.float32)
+        delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), -1)
+        ds = p * (dp - delta[..., None])
+        # reduce over the bias's broadcast (size-1) dims
+        red = tuple(i for i in (0, 1) if bias.shape[i] == 1)
+        dbias = jnp.sum(ds, axis=red, keepdims=True) if red else ds
+        dbias = dbias.astype(bias.dtype)
     return dq, dk, dv, dbias
 
 
@@ -411,15 +442,18 @@ def supports(seq_q, seq_k, head_dim=None,
 
 def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None):
+                    interpret=None, bias_grad=True):
     """Blockwise flash attention.
 
     Args:
       q, k, v: ``(batch, seq, heads, head_dim)`` (paddle layout).
       bias: optional additive mask (bool masks are converted), shape
-        ``(sq, sk)`` or ``(B|1, H|1, sq, sk)``.  The fused backward treats
-        the mask as a constant (zero gradient) — route trainable biases
-        through the einsum path instead.
+        ``(sq, sk)`` or ``(B|1, H|1, sq, sk)``.
+      bias_grad: whether the backward computes the real bias gradient
+        (dS reduced onto the bias shape). Correct-by-default; pass False
+        for constant masks to guarantee the O(sq·sk) score matrix is never
+        materialized in the backward (the F.sdpa wrapper does this
+        automatically from ``mask.stop_gradient``).
       causal: bottom-right-aligned causal mask (row r attends keys
         ``<= r + sk - sq``, matching softmax-attention convention).
       scale: softmax scale; default ``1/sqrt(head_dim)``.
@@ -463,5 +497,6 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
             bias = bias.astype(jnp.float32)
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
     out = _flash(qt, kt, vt, bias, float(scale), bool(causal),
-                 int(block_q), int(block_k), bool(interpret))
+                 int(block_q), int(block_k), bool(interpret),
+                 bool(bias_grad) and bias is not None)
     return jnp.swapaxes(out, 1, 2)

@@ -192,11 +192,15 @@ class Optimizer:
             if g is None:
                 continue
             gv = g._value if isinstance(g, Tensor) else g
-            if p.regularizer is not None:
-                gv = gv + p.regularizer._coeff * p._value
+            # plain leaf Tensors (stop_gradient=False) are optimizable like
+            # Parameters (reference allows both); they lack the Parameter
+            # attrs, hence the getattr defaults
+            reg = getattr(p, "regularizer", None)
+            if reg is not None:
+                gv = gv + reg._coeff * p._value
             else:
                 gv = self._apply_decay(p, gv)
-            param_lr = p.optimize_attr.get("learning_rate", 1.0)
+            param_lr = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             self._step_one(p, gv, lr * param_lr)
 
     def _step_one(self, p, gv, lr_eff):
@@ -282,7 +286,12 @@ class Optimizer:
                 k = f"{key}_{name}"
                 if k in state_dict:
                     v = state_dict[k]
-                    store[key] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    if self._accumulator_transform is not None:
+                        # keep the ZeRO sharding/offload placement on restore
+                        # (never materialize full replicated state per device)
+                        v = self._accumulator_transform(v)
+                    store[key] = v
                     applied.add(k)
         # entries for accumulators not yet created are held back and consumed
         # by _add_accumulator on first touch (lazy creation after restore)
@@ -300,7 +309,17 @@ class Optimizer:
         }
 
     def _load_state_pytree(self, tree):
-        self._accumulators = tree["accumulators"]
+        accs = tree["accumulators"]
+        if self._accumulator_transform is not None:
+            accs = {
+                name: {
+                    k: (self._accumulator_transform(v)
+                        if hasattr(v, "ndim") else v)
+                    for k, v in store.items()
+                } if isinstance(store, dict) else store
+                for name, store in accs.items()
+            }
+        self._accumulators = accs
         # keep the step counter lazy (device array or tracer): calling int()
         # here would block on the ENTIRE compiled step's result every
         # iteration — a host sync that serializes training (this single line

@@ -61,13 +61,27 @@ def _closure_tensors(*fns):
 
     seen, out = set(), []
 
-    def add(v):
-        if isinstance(v, Tensor) and id(v) not in seen:
+    def add(v, depth=0):
+        if depth > 4 or id(v) in seen:
+            return
+        if isinstance(v, Tensor):
             seen.add(id(v))
             out.append(v)
         elif isinstance(v, Layer):
+            seen.add(id(v))
             for q in v.parameters():
-                add(q)
+                add(q, depth)
+        elif isinstance(v, (list, tuple, set)):
+            seen.add(id(v))
+            for q in v:
+                add(q, depth + 1)
+        elif isinstance(v, dict):
+            seen.add(id(v))
+            for q in v.values():
+                add(q, depth + 1)
+        elif getattr(v, "__self__", None) is not None:
+            # bound method: scan the receiver (a Layer holding params, say)
+            add(v.__self__, depth + 1)
 
     for fn in fns:
         for cell in (getattr(fn, "__closure__", None) or ()):

@@ -1,0 +1,190 @@
+"""paddle.distribution: sampling statistics, log_prob parity vs scipy,
+kl_divergence rules, transforms, reparameterized gradients.
+Reference: python/paddle/distribution/ + its unittests/distribution suite."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform, Beta, Categorical, ChainTransform, Dirichlet, ExpTransform,
+    Independent, Multinomial, Normal, SigmoidTransform, TanhTransform,
+    TransformedDistribution, Uniform, kl_divergence, register_kl,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_normal_logprob_entropy_vs_scipy():
+    loc, scale = 0.7, 1.3
+    d = Normal(loc, scale)
+    v = np.linspace(-3, 3, 11).astype(np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(Tensor(v))),
+                               st.norm.logpdf(v, loc, scale), atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.norm.entropy(loc, scale), atol=1e-5)
+    assert float(_np(d.mean)) == pytest.approx(loc)
+    assert float(_np(d.variance)) == pytest.approx(scale ** 2)
+
+
+def test_normal_sampling_moments_and_rsample_grad():
+    paddle.seed(0)
+    d = Normal(Tensor(np.float32(2.0)), Tensor(np.float32(0.5)))
+    s = d.sample([20000])
+    assert abs(_np(s).mean() - 2.0) < 0.02
+    assert abs(_np(s).std() - 0.5) < 0.02
+
+    # reparameterized: gradient flows to loc/scale
+    loc = Tensor(np.float32(0.0), stop_gradient=False)
+    scale = Tensor(np.float32(1.0), stop_gradient=False)
+    d2 = Normal(loc, scale)
+    out = d2.rsample([1000])
+    (out * out).mean().backward()
+    assert loc.grad is not None and scale.grad is not None
+    # d E[(loc + scale*eps)^2] / dscale = 2*scale ~ 2
+    assert abs(float(_np(scale.grad)) - 2.0) < 0.2
+
+
+def test_uniform_basic():
+    d = Uniform(1.0, 3.0)
+    v = np.array([0.5, 1.5, 2.9, 3.5], np.float32)
+    lp = _np(d.log_prob(Tensor(v)))
+    np.testing.assert_allclose(lp[1:3], np.log(0.5), atol=1e-6)
+    assert np.isneginf(lp[0]) and np.isneginf(lp[3])
+    assert float(_np(d.entropy())) == pytest.approx(np.log(2.0))
+    paddle.seed(1)
+    s = _np(d.sample([5000]))
+    assert s.min() >= 1.0 and s.max() < 3.0
+    assert abs(s.mean() - 2.0) < 0.05
+
+
+def test_categorical_logprob_entropy_sampling():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+    d = Categorical(Tensor(logits))
+    lp = _np(d.log_prob(Tensor(np.array([2], np.int64))))
+    np.testing.assert_allclose(lp, np.log(0.5), atol=1e-6)
+    np.testing.assert_allclose(float(_np(d.entropy())[0]),
+                               st.entropy([0.2, 0.3, 0.5]), atol=1e-5)
+    paddle.seed(2)
+    s = _np(d.sample([8000]))
+    freq = np.bincount(s.ravel(), minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+
+def test_beta_vs_scipy():
+    a, b = 2.0, 5.0
+    d = Beta(a, b)
+    v = np.array([0.1, 0.4, 0.8], np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(Tensor(v))),
+                               st.beta.logpdf(v, a, b), atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.beta.entropy(a, b), atol=1e-5)
+    assert float(_np(d.mean)) == pytest.approx(a / (a + b))
+
+
+def test_dirichlet_vs_scipy():
+    conc = np.array([2.0, 3.0, 4.0], np.float32)
+    d = Dirichlet(Tensor(conc))
+    v = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(float(_np(d.log_prob(Tensor(v)))),
+                               st.dirichlet.logpdf(v, conc), atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               st.dirichlet.entropy(conc), atol=1e-5)
+    paddle.seed(3)
+    s = _np(d.sample([4000]))
+    np.testing.assert_allclose(s.mean(0), conc / conc.sum(), atol=0.02)
+
+
+def test_multinomial():
+    probs = np.array([0.25, 0.25, 0.5], np.float32)
+    d = Multinomial(10, Tensor(probs))
+    v = np.array([2.0, 3.0, 5.0], np.float32)
+    np.testing.assert_allclose(float(_np(d.log_prob(Tensor(v)))),
+                               st.multinomial.logpmf(v, 10, probs), atol=1e-5)
+    paddle.seed(4)
+    s = _np(d.sample([2000]))
+    assert s.shape == (2000, 3)
+    np.testing.assert_allclose(s.sum(-1), 10.0)
+    np.testing.assert_allclose(s.mean(0), 10 * probs, atol=0.2)
+    with pytest.raises(ValueError):
+        Multinomial(0, Tensor(probs))
+
+
+def test_kl_rules():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    expect = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+    np.testing.assert_allclose(float(_np(kl_divergence(p, q))), expect, atol=1e-6)
+    np.testing.assert_allclose(float(_np(p.kl_divergence(q))), expect, atol=1e-6)
+
+    c1 = Categorical(Tensor(np.log(np.array([0.3, 0.7], np.float32))))
+    c2 = Categorical(Tensor(np.log(np.array([0.5, 0.5], np.float32))))
+    expect = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+    np.testing.assert_allclose(float(_np(kl_divergence(c1, c2))), expect, atol=1e-6)
+
+    b1, b2 = Beta(2.0, 3.0), Beta(4.0, 2.0)
+    # numeric reference via scipy integration of p*log(p/q)
+    from scipy.integrate import quad
+
+    f = lambda x: st.beta.pdf(x, 2, 3) * (st.beta.logpdf(x, 2, 3) - st.beta.logpdf(x, 4, 2))
+    expect, _ = quad(f, 1e-9, 1 - 1e-9)
+    np.testing.assert_allclose(float(_np(kl_divergence(b1, b2))), expect, atol=1e-4)
+
+    d1 = Dirichlet(Tensor(np.array([1.0, 2.0], np.float32)))
+    d2 = Dirichlet(Tensor(np.array([2.0, 2.0], np.float32)))
+    assert float(_np(kl_divergence(d1, d2))) > 0
+
+    with pytest.raises(NotImplementedError):
+        kl_divergence(p, c1)
+
+
+def test_register_kl_custom():
+    class MyN(Normal):
+        pass
+
+    @register_kl(MyN, Normal)
+    def _rule(p, q):
+        return Tensor(np.float32(42.0))
+
+    assert float(_np(kl_divergence(MyN(0.0, 1.0), Normal(0.0, 1.0)))) == 42.0
+
+
+def test_transforms_roundtrip_and_jacobian():
+    x = Tensor(np.array([0.3, -0.7, 1.2], np.float32))
+    for t in (ExpTransform(), AffineTransform(2.0, 3.0), SigmoidTransform(),
+              TanhTransform()):
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(_np(back), _np(x), atol=1e-5)
+    # chain: exp(2x+1)
+    ch = ChainTransform([AffineTransform(1.0, 2.0), ExpTransform()])
+    y = ch.forward(x)
+    np.testing.assert_allclose(_np(y), np.exp(2 * _np(x) + 1), rtol=1e-5)
+    # |dy/dx| = 2*exp(2x+1)
+    np.testing.assert_allclose(_np(ch.forward_log_det_jacobian(x)),
+                               np.log(2.0) + 2 * _np(x) + 1, atol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    """exp(Normal) == LogNormal: log_prob parity with scipy."""
+    d = TransformedDistribution(Normal(0.0, 1.0), [ExpTransform()])
+    v = np.array([0.5, 1.0, 2.5], np.float32)
+    np.testing.assert_allclose(_np(d.log_prob(Tensor(v))),
+                               st.lognorm.logpdf(v, 1.0), atol=1e-5)
+    paddle.seed(5)
+    s = _np(d.sample([8000]))
+    assert abs(np.log(s).mean()) < 0.05
+
+
+def test_independent_sums_event_dims():
+    base = Normal(Tensor(np.zeros((3, 4), np.float32)),
+                  Tensor(np.ones((3, 4), np.float32)))
+    ind = Independent(base, 1)
+    assert ind.batch_shape == [3] and ind.event_shape == [4]
+    v = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(_np(ind.log_prob(Tensor(v))),
+                               _np(base.log_prob(Tensor(v))).sum(-1), atol=1e-6)
+    with pytest.raises(ValueError):
+        Independent(base, 3)

@@ -1,0 +1,127 @@
+"""MoE stack tests (ADVICE round-2: moe_layer shipped without coverage):
+dense-loop parity vs the dispatched-einsum path, capacity-drop behavior,
+aux-loss value, gradient flow through gate and experts.
+Reference: incubate/distributed/models/moe/moe_layer.py:244, moe/gate/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.incubate.distributed.models.moe import MoELayer
+from paddle_tpu.utils import unique_name
+
+
+def _experts(n, d, seed=0):
+    with unique_name.guard():
+        paddle.seed(seed)
+        return [paddle.nn.Sequential(paddle.nn.Linear(d, 2 * d),
+                                     paddle.nn.ReLU(),
+                                     paddle.nn.Linear(2 * d, d))
+                for _ in range(n)]
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_naive_gate_dense_parity():
+    """top-1 gate with generous capacity == dense per-expert loop."""
+    d, n_exp, tokens = 8, 4, 16
+    experts = _experts(n_exp, d)
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "naive"},
+                   capacity_factor=float(n_exp))  # no drops
+    x = Tensor(np.random.RandomState(0).randn(tokens, d).astype(np.float32))
+    out = moe(x)
+
+    # dense reference: route each token to argmax expert, scale by softmax prob
+    logits = _np(moe.gate.logits(x))
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    top = logits.argmax(-1)
+    ref = np.zeros((tokens, d), np.float32)
+    for t in range(tokens):
+        e = int(top[t])
+        y = experts[e](Tensor(_np(x)[t:t + 1]))
+        ref[t] = _np(y)[0] * probs[t, e]
+    np.testing.assert_allclose(_np(out), ref, atol=1e-5)
+
+
+def test_capacity_drop():
+    """With capacity 1 token/expert, overflow tokens produce zero output."""
+    d, n_exp = 4, 2
+    experts = _experts(n_exp, d, seed=1)
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "naive"})
+    # force tiny capacity
+    moe.gate.capacity = lambda num_tokens, k=1: 1
+    x = Tensor(np.random.RandomState(1).randn(8, d).astype(np.float32))
+    out = _np(moe(x))
+    zero_rows = (np.abs(out).sum(-1) < 1e-7).sum()
+    # 8 tokens, 2 experts x capacity 1 -> at least 6 dropped
+    assert zero_rows >= 6, zero_rows
+
+
+def test_gshard_aux_loss_value_and_balance():
+    """aux loss == num_experts * sum(me * ce) (GShard eq.); uniform routing
+    gives ~1.0, concentrated routing gives ~num_experts."""
+    d, n_exp, tokens = 6, 3, 300
+    experts = _experts(n_exp, d, seed=2)
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "gshard"})
+    x = Tensor(np.random.RandomState(2).randn(tokens, d).astype(np.float32))
+    moe(x)
+    aux = float(_np(moe.aux_loss))
+    assert 0.5 < aux < float(n_exp) + 0.5, aux
+
+    # concentrated: bias the gate so everything routes to expert 0
+    w = moe.gate.parameters()[0]
+    wv = _np(w).copy()
+    wv[:, 0] += 50.0
+    w._value = wv
+    # positive inputs so the +50 weight column dominates every logit
+    x = Tensor(np.abs(np.random.RandomState(2).randn(tokens, d)).astype(np.float32))
+    moe(x)
+    aux_conc = float(_np(moe.aux_loss))
+    assert aux_conc > aux, (aux_conc, aux)
+    np.testing.assert_allclose(aux_conc, float(n_exp), rtol=0.05)
+
+
+def test_gradients_flow_through_gate_and_experts():
+    d, n_exp = 6, 2
+    experts = _experts(n_exp, d, seed=3)
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "gshard"})
+    params = moe.parameters()
+    x = Tensor(np.random.RandomState(3).randn(12, d).astype(np.float32),
+               stop_gradient=False)
+    out = moe(x)
+    loss = (out * out).mean() + 0.01 * moe.aux_loss
+    loss.backward()
+    assert x.grad is not None
+    got_grad = sum(
+        1 for p in params
+        if p.grad is not None and float(np.abs(_np(p.grad)).sum()) > 0
+    )
+    # the gate weight and the stacked expert weights all get gradients
+    assert got_grad >= len(params) - 1, (got_grad, len(params))
+
+
+def test_moe_trains_in_jitted_step():
+    from paddle_tpu.jit.functionalize import CompiledStep
+
+    d = 4
+    experts = _experts(2, d, seed=4)
+    moe = MoELayer(d_model=d, experts=experts, gate={"type": "switch"})
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=moe.parameters())
+    x = Tensor(np.random.RandomState(4).randn(16, d).astype(np.float32))
+
+    def step(xb):
+        out = moe(xb)
+        loss = (out - 1.0).square().mean() + 0.01 * moe.aux_loss
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    cs = CompiledStep(step, stateful=[moe, opt])
+    l0 = float(_np(cs(x)))
+    for _ in range(6):
+        l1 = float(_np(cs(x)))
+    assert np.isfinite(l1) and l1 < l0
