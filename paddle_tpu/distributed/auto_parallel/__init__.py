@@ -1,0 +1,17 @@
+"""Semi-automatic parallelism (reference
+``python/paddle/distributed/auto_parallel/``: ``process_mesh.py:39``,
+``interface.py:34 shard_tensor``, ``engine.py:51 Engine``, plus the
+completion/partition/reshard passes).
+
+TPU-native redesign: the reference's completion (dist-attr propagation),
+partitioner (program splitting) and reshard (cross-mesh transfer insertion)
+are EXACTLY what XLA's GSPMD partitioner does from sharding annotations —
+so here ``shard_tensor`` lowers a dims_mapping onto a ``NamedSharding`` and
+the whole pipeline after that is the compiler. ``Engine`` is the same
+user surface (prepare/fit/evaluate/predict) driving one jitted SPMD step.
+"""
+from .process_mesh import ProcessMesh  # noqa: F401
+from .interface import shard_tensor, shard_op  # noqa: F401
+from .engine import Engine  # noqa: F401
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]

@@ -1,0 +1,183 @@
+"""auto_parallel Engine (reference ``auto_parallel/engine.py:51``):
+prepare/fit/evaluate/predict over an annotated model.
+
+TPU-native: the reference Engine builds a dist program per mode and runs
+completion/partition passes; here each mode is one jitted SPMD step whose
+parallelization comes from the model's/batch's sharding annotations —
+GSPMD is the planner. Data is sharded over the mesh's FIRST dim by default
+(the reference's default data-parallel dim)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...metric import Metric
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["Engine"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 cluster=None, strategy=None, process_mesh=None):
+        self.model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = _to_list(metrics)
+        self.cluster = cluster
+        self.strategy = strategy
+        pm = process_mesh or get_current_process_mesh()
+        if pm is None:
+            n = len(jax.devices())
+            pm = ProcessMesh(np.arange(n), dim_names=["dp"])
+        self._pm = pm
+        self._train_step = None
+        self._eval_step = None
+
+    # -- data placement ------------------------------------------------------
+    def _shard_batch(self, arr):
+        arr = np.asarray(arr)
+        mesh = self._pm.jax_mesh
+        dp = mesh.shape[self._pm.dim_names[0]]
+        spec = [None] * arr.ndim
+        if arr.ndim and arr.shape[0] % dp == 0:
+            spec[0] = self._pm.dim_names[0]
+        # else: replicate (batch not divisible by the data dim)
+        return Tensor(jax.device_put(arr, NamedSharding(mesh, P(*spec))))
+
+    def _replicate_params(self):
+        mesh = self._pm.jax_mesh
+        repl = NamedSharding(mesh, P())
+        for p in self.model.parameters():
+            sh = getattr(p._value, "sharding", None)
+            if not (isinstance(sh, NamedSharding) and sh.mesh.shape == mesh.shape
+                    and sh.spec != P()):
+                p._value = jax.device_put(p._value, repl)
+
+    # -- steps ---------------------------------------------------------------
+    def _ensure_train(self):
+        if self._train_step is None:
+            from ...jit.functionalize import CompiledStep
+
+            model, loss_fn, opt = self.model, self._loss, self._optimizer
+            self._replicate_params()
+
+            def step(x, y):
+                out = model(x)
+                loss = loss_fn(out, y)
+                loss = loss.mean() if loss.ndim > 0 else loss
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss, out
+
+            self._train_step = CompiledStep(step, stateful=[model, opt],
+                                            donate_state=True)
+        return self._train_step
+
+    def _ensure_eval(self):
+        if self._eval_step is None:
+            from ...jit.functionalize import CompiledStep
+
+            model, loss_fn = self.model, self._loss
+
+            def step(x, y):
+                out = model(x)
+                loss = loss_fn(out, y)
+                return (loss.mean() if loss.ndim > 0 else loss), out
+
+            self._eval_step = CompiledStep(step, stateful=[self.model],
+                                           donate_state=False)
+        return self._eval_step
+
+    # -- public API (reference engine.py fit/evaluate/predict) ---------------
+    def fit(self, train_data, batch_size=1, epochs=1, steps_per_epoch=None,
+            verbose=0, collate_fn=None):
+        from ...io import DataLoader
+
+        loader = (train_data if isinstance(train_data, DataLoader)
+                  else DataLoader(train_data, batch_size=batch_size,
+                                  shuffle=True, drop_last=True,
+                                  collate_fn=collate_fn))
+        step = self._ensure_train()
+        history = []
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                if steps_per_epoch is not None and i >= steps_per_epoch:
+                    break
+                x, y = batch[0], batch[1]
+                loss, out = step(self._shard_batch(np.asarray(x._value)),
+                                 self._shard_batch(np.asarray(y._value)))
+                history.append(float(np.asarray(loss._value)))
+                if verbose and i % 10 == 0:
+                    print(f"epoch {epoch} step {i}: loss {history[-1]:.4f}")
+        return {"loss": history}
+
+    def evaluate(self, valid_data, batch_size=1, collate_fn=None):
+        from ...io import DataLoader
+
+        loader = (valid_data if isinstance(valid_data, DataLoader)
+                  else DataLoader(valid_data, batch_size=batch_size,
+                                  drop_last=True, collate_fn=collate_fn))
+        step = self._ensure_eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = batch[0], batch[1]
+            loss, out = step(self._shard_batch(np.asarray(x._value)),
+                             self._shard_batch(np.asarray(y._value)))
+            losses.append(float(np.asarray(loss._value)))
+            for m in self._metrics:
+                if isinstance(m, Metric):
+                    state = m.compute(out, Tensor(np.asarray(y._value)))
+                    m.update(*[np.asarray(s._value) if isinstance(s, Tensor)
+                               else s for s in _to_list(state)])
+        logs = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            logs[m.name() if isinstance(m.name(), str) else m.name()[0]] = \
+                m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, collate_fn=None):
+        from ...io import DataLoader
+
+        loader = (test_data if isinstance(test_data, DataLoader)
+                  else DataLoader(test_data, batch_size=batch_size,
+                                  collate_fn=collate_fn))
+        model = self.model
+        model.eval()
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            outs.append(np.asarray(model(
+                self._shard_batch(np.asarray(x._value)))._value))
+        model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ...framework.io import save
+
+        save(self.model.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        from ...framework.io import load
+
+        self.model.set_state_dict(load(path + ".pdparams"))
+        import os
+
+        if load_optimizer and self._optimizer is not None and os.path.exists(
+                path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+        self._train_step = None
+        self._eval_step = None

@@ -1,0 +1,91 @@
+"""shard_tensor / shard_op (reference ``auto_parallel/interface.py:34``).
+
+``dims_mapping[i] = j`` means tensor dim i is split across mesh dim j
+(-1 = replicated). The annotation lowers to a NamedSharding; GSPMD performs
+the completion/partition/reshard the reference implements as passes."""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+__all__ = ["shard_tensor", "shard_op"]
+
+
+def _sharding_from(dist_attr):
+    dist_attr = dist_attr or {}
+    pm = dist_attr.get("process_mesh") or get_current_process_mesh()
+    if pm is None:
+        raise ValueError(
+            "shard_tensor needs a process_mesh (pass one in dist_attr or "
+            "enter a ProcessMesh context)")
+    if not isinstance(pm, ProcessMesh):
+        pm = ProcessMesh(pm)
+    dm = dist_attr.get("dims_mapping")
+    mesh = pm.jax_mesh
+    if dm is None:
+        spec = P()
+    else:
+        # entries may be mesh-dim indices (-1 = replicate), mesh-dim names,
+        # or None (the newer paddle shard_spec convention)
+        names = pm.dim_names
+        axes = []
+        for j in dm:
+            if j is None or j == -1:
+                axes.append(None)
+            elif isinstance(j, str):
+                if j not in names:
+                    raise ValueError(
+                        f"unknown mesh dim {j!r}; mesh dims: {names}")
+                axes.append(j)
+            else:
+                axes.append(names[j])
+        spec = P(*axes)
+    return NamedSharding(mesh, spec)
+
+
+def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None):
+    """Annotate ``x``'s placement. Accepts the reference dict form
+    ``{"process_mesh": pm, "dims_mapping": [0, -1]}`` or the keyword form."""
+    if dist_attr is None and (process_mesh is not None or shard_spec is not None):
+        dist_attr = {"process_mesh": process_mesh, "dims_mapping": shard_spec}
+    sh = _sharding_from(dist_attr)
+
+    def fwd(v):
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, sh)
+        return jax.device_put(v, sh)
+
+    return apply_op("shard_tensor", fwd, (x,), {})
+
+
+def shard_op(op_fn, dist_attr=None, in_dims_mappings=None,
+             out_dims_mappings=None):
+    """Reference ``interface.py shard_op``: annotate an op call's inputs and
+    outputs. Returns a wrapped callable."""
+
+    def wrapped(*args, **kwargs):
+        new_args = []
+        for i, a in enumerate(args):
+            dm = (in_dims_mappings[i]
+                  if in_dims_mappings and i < len(in_dims_mappings) else None)
+            if isinstance(a, Tensor) and dm is not None:
+                da = dict(dist_attr or {})
+                da["dims_mapping"] = dm
+                a = shard_tensor(a, da)
+            new_args.append(a)
+        out = op_fn(*new_args, **kwargs)
+        if out_dims_mappings:
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            outs = [
+                shard_tensor(o, {**(dist_attr or {}), "dims_mapping": dm})
+                if dm is not None else o
+                for o, dm in zip(outs, out_dims_mappings)
+            ]
+            out = type(out)(outs) if isinstance(out, (tuple, list)) else outs[0]
+        return out
+
+    return wrapped

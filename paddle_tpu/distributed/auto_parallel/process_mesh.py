@@ -1,0 +1,95 @@
+"""ProcessMesh (reference ``auto_parallel/process_mesh.py:39``)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ProcessMesh"]
+
+_CUR_MESH = None
+
+
+def get_current_process_mesh():
+    return _CUR_MESH
+
+
+class ProcessMesh:
+    """An N-D arrangement of processes (reference ProcessMesh): here each
+    "process" id indexes ``jax.devices()`` and the mesh lowers directly to a
+    ``jax.sharding.Mesh`` whose axis names are ``dim_names`` (default
+    ``d0, d1, ...``)."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is None and process_ids is not None:
+            mesh = np.asarray(process_ids).reshape(shape)
+        arr = np.asarray(mesh)
+        if arr.ndim == 0:
+            raise ValueError("mesh must have at least one dimension")
+        self._ids = arr
+        self._dim_names = (list(dim_names) if dim_names
+                           else [f"d{i}" for i in range(arr.ndim)])
+        if len(self._dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(self._dim_names)} dim_names for a {arr.ndim}-D mesh")
+        self._jax_mesh = None
+
+    # reference API surface
+    @property
+    def mesh(self):
+        return self._ids.tolist()
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def processes(self):
+        return self._ids.reshape(-1).tolist()
+
+    @property
+    def process_ids(self):
+        return self.processes
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def topology(self):
+        return self.shape
+
+    # TPU lowering
+    @property
+    def jax_mesh(self):
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            devs = jax.devices()
+            picked = np.empty(self._ids.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._ids):
+                picked[idx] = devs[int(pid)]
+            self._jax_mesh = Mesh(picked, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __enter__(self):
+        global _CUR_MESH
+        self._prev = _CUR_MESH
+        _CUR_MESH = self
+        return self
+
+    def __exit__(self, *exc):
+        global _CUR_MESH
+        _CUR_MESH = self._prev
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"

@@ -16,6 +16,7 @@ __all__ = [
     "ModelCheckpoint",
     "LRScheduler",
     "EarlyStopping",
+    "VisualDL",
 ]
 
 
@@ -218,6 +219,45 @@ class LRScheduler(Callback):
             s = self._sched()
             if s is not None:
                 s.step()
+
+
+class VisualDL(Callback):
+    """Reference ``callbacks.py VisualDL``: stream train/eval scalars to a
+    LogWriter (JSONL records, utils/log_writer.py)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._train_step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils.log_writer import LogWriter
+
+            self._writer = LogWriter(self.log_dir)
+        return self._writer
+
+    def _log(self, prefix, logs, step):
+        import numbers
+
+        for k, v in (logs or {}).items():
+            if isinstance(v, numbers.Number):
+                self._w().add_scalar(f"{prefix}/{k}", v, step)
+            elif isinstance(v, (list, tuple)) and v and isinstance(v[0], numbers.Number):
+                self._w().add_scalar(f"{prefix}/{k}", v[0], step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        self._log("train", logs, self._train_step)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs, self._train_step)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
 
 
 class EarlyStopping(Callback):

@@ -1,3 +1,5 @@
 """paddle.incubate (reference ``python/paddle/incubate/``)."""
 from . import autograd  # noqa: F401
 from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,160 @@
+"""incubate optimizers (reference ``python/paddle/incubate/optimizer/``:
+``lookahead.py LookAhead``, ``modelaverage.py ModelAverage``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """Reference ``lookahead.py``: k fast steps with the inner optimizer,
+    then slow weights move ``alpha`` toward the fast weights and the fast
+    weights reset to slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("inner_optimizer must be an Optimizer")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        # slow weights seeded from the INITIAL parameters (reference
+        # lookahead.py seeds the accumulator with the param value at
+        # creation, before any fast step). Keyed by param name so the state
+        # forms a stable pytree for jit threading.
+        # copy: slow weights must be distinct buffers from the params (a
+        # shared buffer would be donated twice under a donating jit step)
+        self._slow = {self._pname(p): jnp.array(p._value, copy=True)
+                      for p in (inner_optimizer._parameter_list or [])}
+        self._k_count = jnp.zeros((), jnp.int32)
+        self._parameter_list = inner_optimizer._parameter_list
+
+    @staticmethod
+    def _pname(p):
+        return Optimizer._pkey(p)
+
+    def step(self):
+        """jit-compatible: the every-k sync is a traced ``where`` blend, and
+        the counter/slow weights are threaded state (see _state_pytree)."""
+        self.inner_optimizer.step()
+        self._k_count = self._k_count + 1
+        sync = (self._k_count % self.k) == 0
+        for p in self.inner_optimizer._parameter_list or []:
+            key = self._pname(p)
+            slow = self._slow[key].astype(jnp.float32)
+            fast = p._value.astype(jnp.float32)
+            slow_new = jnp.where(sync, slow + self.alpha * (fast - slow), slow)
+            self._slow[key] = slow_new
+            p._value = jnp.where(sync, slow_new, fast).astype(p._value.dtype)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero=set_to_zero)
+
+    # -- state threading (CompiledStep) / checkpointing ----------------------
+    def _state_pytree(self):
+        return {
+            "inner": self.inner_optimizer._state_pytree(),
+            "slow": dict(self._slow),
+            "k_count": self._k_count,
+        }
+
+    def _load_state_pytree(self, tree):
+        self.inner_optimizer._load_state_pytree(tree["inner"])
+        self._slow = dict(tree["slow"])
+        self._k_count = tree["k_count"]
+
+    def state_dict(self):
+        import numpy as np
+
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_k_count"] = int(np.asarray(self._k_count))
+        for key, v in self._slow.items():
+            sd[f"@lookahead_slow_{key}"] = v
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        self._k_count = jnp.asarray(int(sd.pop("@lookahead_k_count", 0)),
+                                    jnp.int32)
+        for key in list(self._slow):
+            v = sd.pop(f"@lookahead_slow_{key}", None)
+            if v is not None:
+                self._slow[key] = jnp.asarray(
+                    v._value if isinstance(v, Tensor) else v)
+        self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["inner_optimizer"], name)
+
+
+class ModelAverage:
+    """Reference ``modelaverage.py``: exponential/windowed average of
+    parameter trajectories; ``apply()`` swaps averaged weights in (context
+    manager), ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._value) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step())."""
+        self._count += 1
+        if self._count > self.max_window:
+            # restart the window (reference restart semantics)
+            for p in self._params:
+                self._sum[id(p)] = p._value.astype(jnp.float32)
+            self._count = 1
+            return
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._value.astype(jnp.float32)
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights. Usable as a context manager."""
+        if self._count == 0:
+            raise RuntimeError("ModelAverage.apply before any step()")
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            p._value = (self._sum[id(p)] / self._count).astype(p._value.dtype)
+        mgr = self
+
+        class _Ctx:
+            def __enter__(self_c):
+                return mgr
+
+            def __exit__(self_c, *exc):
+                if need_restore:
+                    mgr.restore()
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._value = self._backup[id(p)]
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()

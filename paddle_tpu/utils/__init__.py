@@ -1,0 +1,5 @@
+"""paddle.utils (reference ``python/paddle/utils/``)."""
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+from .log_writer import LogWriter, Monitor, get_monitor  # noqa: F401

@@ -1,0 +1,63 @@
+"""Custom-op extension point.
+
+Reference: ``paddle/fluid/framework/custom_operator.cc`` + ``phi/api/ext``
+(user out-of-tree C++ ops registered at runtime) and
+``python/paddle/utils/cpp_extension``.
+
+TPU-native redesign: a custom op is a jax-traceable forward (python; may
+itself wrap an XLA custom_call / Pallas kernel / ``jax.pure_callback`` into
+native code) plus an optional backward. :func:`register_custom_op` installs
+it in the global op registry with full autograd/jit/static-recording
+support — the role the reference's REGISTER_OP + dynamic library loading
+plays, without the ABI surface XLA already owns.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..ops.dispatch import OP_REGISTRY, op
+
+__all__ = ["register_custom_op", "CustomOpError"]
+
+
+class CustomOpError(RuntimeError):
+    pass
+
+
+def register_custom_op(name, forward, backward=None, num_inputs=None):
+    """Register ``name`` as a framework op.
+
+    Args:
+        forward: jax-level function ``(*arrays, **attrs) -> array(s)``.
+        backward: optional ``(residuals, grads) -> input-cotangents`` pair
+            given as ``(save_fn, grad_fn)`` where ``save_fn(*arrays) ->
+            (out, residuals)``; when omitted, autodiff falls back to
+            ``jax.vjp`` of ``forward``.
+        num_inputs: arity check (optional).
+
+    Returns the callable op (also retrievable via the registry).
+    """
+    if name in OP_REGISTRY:
+        raise CustomOpError(f"op {name!r} is already registered")
+    fwd = forward
+    if backward is not None:
+        save_fn, grad_fn = backward
+        fwd = jax.custom_vjp(forward)
+        fwd.defvjp(save_fn, grad_fn)
+
+    wrapper = op(name)(fwd)
+
+    if num_inputs is not None:
+        inner = wrapper
+
+        def checked(*args, **kwargs):
+            n_pos = len(args)
+            if n_pos != num_inputs:
+                raise CustomOpError(
+                    f"custom op {name!r} expects {num_inputs} inputs, got {n_pos}")
+            return inner(*args, **kwargs)
+
+        checked.op_name = name
+        OP_REGISTRY[name] = checked
+        wrapper = checked
+    return wrapper

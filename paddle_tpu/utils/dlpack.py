@@ -1,0 +1,24 @@
+"""DLPack interop (reference ``paddle/fluid/framework/dlpack_tensor.cc`` +
+``python/paddle/utils/dlpack.py``): zero-copy tensor exchange with other
+frameworks via the DLPack protocol, delegated to jax's implementation."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule (reference ``utils/dlpack.py
+    to_dlpack``). The source array must stay alive while the capsule is."""
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a paddle Tensor, got {type(x)}")
+    return x._value.__dlpack__()
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack capsule or any object with ``__dlpack__`` (torch/numpy
+    arrays included) as a Tensor."""
+    return Tensor(jnp.from_dlpack(dlpack))

@@ -1,0 +1,95 @@
+"""VisualDL-compatible scalar logging (reference: the ``visualdl`` package
+used by ``hapi/callbacks.py VisualDL`` and ``platform/monitor.h`` stat
+registry). Records land in JSONL files — one line per datum — so any
+dashboard (or plain pandas) can read them without a VisualDL install."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["LogWriter", "get_monitor", "Monitor"]
+
+
+class LogWriter:
+    """``LogWriter(logdir).add_scalar(tag, value, step)`` (VisualDL API)."""
+
+    def __init__(self, logdir, max_queue=20, flush_secs=120, filename_suffix="",
+                 display_name="", file_name="", **kwargs):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        name = file_name or f"vdlrecords.{int(time.time())}{filename_suffix}.jsonl"
+        self._path = os.path.join(logdir, name)
+        self._f = open(self._path, "a", buffering=1)
+
+    @property
+    def file_name(self):
+        return self._path
+
+    def add_scalar(self, tag, value, step=None, walltime=None):
+        self._f.write(json.dumps({
+            "tag": tag, "value": float(value),
+            "step": int(step) if step is not None else None,
+            "walltime": walltime or time.time(),
+        }) + "\n")
+
+    def add_text(self, tag, text_string, step=None):
+        self._f.write(json.dumps({
+            "tag": tag, "text": str(text_string),
+            "step": int(step) if step is not None else None,
+        }) + "\n")
+
+    def add_hparams(self, hparams_dict, metrics_list=None):
+        self._f.write(json.dumps({"hparams": hparams_dict,
+                                  "metrics": metrics_list or []}) + "\n")
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Monitor:
+    """Host-side stat registry (reference ``platform/monitor.h``)."""
+
+    def __init__(self):
+        self._stats = {}
+
+    def add(self, name, value):
+        s = self._stats.setdefault(name, {"count": 0, "sum": 0.0,
+                                          "min": float("inf"),
+                                          "max": float("-inf")})
+        v = float(value)
+        s["count"] += 1
+        s["sum"] += v
+        s["min"] = min(s["min"], v)
+        s["max"] = max(s["max"], v)
+
+    def get(self, name):
+        return dict(self._stats.get(name, {}))
+
+    def names(self):
+        return sorted(self._stats)
+
+    def reset(self, name=None):
+        if name is None:
+            self._stats.clear()
+        else:
+            self._stats.pop(name, None)
+
+
+_MONITOR = Monitor()
+
+
+def get_monitor():
+    return _MONITOR

@@ -1,3 +1,4 @@
 """paddle.vision equivalent."""
 from . import datasets, models, transforms  # noqa: F401
+from . import ops  # noqa: F401
 from .models import LeNet, ResNet  # noqa: F401

@@ -1,0 +1,281 @@
+"""paddle.vision.ops — detection operators.
+
+Reference: ``python/paddle/vision/ops.py`` (roi_align/roi_pool/nms/
+deform_conv2d) backed by CUDA kernels under ``paddle/phi/kernels/gpu/``.
+TPU-native: bilinear sampling expressed as gathers + weighted sums that XLA
+vectorizes, vmapped over RoIs/kernel-offsets; greedy NMS as a
+``lax.fori_loop`` over score-sorted boxes (sequential by definition)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_op, op
+
+__all__ = ["roi_align", "roi_pool", "nms", "deform_conv2d", "DeformConv2D"]
+
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y,x arbitrary same-shape grids -> [C, *grid]."""
+    H, W = feat.shape[-2:]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0.0, 1.0)
+    wx = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, y1i, x0i, x1i = (a.astype(jnp.int32) for a in (y0, y1, x0, x1))
+
+    def g(yy, xx):
+        return feat[:, yy, xx]
+
+    v = (g(y0i, x0i) * (1 - wy) * (1 - wx) + g(y0i, x1i) * (1 - wy) * wx
+         + g(y1i, x0i) * wy * (1 - wx) + g(y1i, x1i) * wy * wx)
+    # zero outside the feature map (reference behavior for OOB samples)
+    inside = (y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W)
+    return jnp.where(inside, v, 0.0)
+
+
+@op("roi_align")
+def _roi_align_raw(x, boxes, boxes_num, output_size=(1, 1), spatial_scale=1.0,
+                   sampling_ratio=-1, aligned=True):
+    ph, pw = output_size
+    n_img = x.shape[0]
+    # image index per roi from boxes_num
+    counts = boxes_num.astype(jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(n_img), counts,
+                         total_repeat_length=boxes.shape[0])
+
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(box, idx):
+        feat = x[idx]
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - off, y1 - off
+        x2, y2 = x2 - off, y2 - off
+        w = x2 - x1
+        h = y2 - y1
+        if not aligned:
+            w = jnp.maximum(w, 1.0)
+            h = jnp.maximum(h, 1.0)
+        bin_h, bin_w = h / ph, w / pw
+        # sr x sr samples per bin, averaged
+        iy = (jnp.arange(ph)[:, None] * bin_h + y1
+              + (jnp.arange(sr) + 0.5)[None, :] * bin_h / sr)  # [ph, sr]
+        ix = (jnp.arange(pw)[:, None] * bin_w + x1
+              + (jnp.arange(sr) + 0.5)[None, :] * bin_w / sr)  # [pw, sr]
+        yy = iy.reshape(-1)[:, None]          # [ph*sr, 1]
+        xx = ix.reshape(-1)[None, :]          # [1, pw*sr]
+        grid_y = jnp.broadcast_to(yy, (ph * sr, pw * sr))
+        grid_x = jnp.broadcast_to(xx, (ph * sr, pw * sr))
+        v = _bilinear(feat, grid_y, grid_x)   # [C, ph*sr, pw*sr]
+        C = v.shape[0]
+        v = v.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+        return v
+
+    return jax.vmap(one_roi)(boxes, img_idx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Reference ``vision/ops.py roi_align``. x [N,C,H,W]; boxes
+    [num_rois, 4] (x1,y1,x2,y2); boxes_num [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align_raw(x, boxes, boxes_num, output_size=tuple(output_size),
+                          spatial_scale=float(spatial_scale),
+                          sampling_ratio=int(sampling_ratio),
+                          aligned=bool(aligned))
+
+
+@op("roi_pool")
+def _roi_pool_raw(x, boxes, boxes_num, output_size=(1, 1), spatial_scale=1.0):
+    ph, pw = output_size
+    n_img = x.shape[0]
+    H, W = x.shape[-2:]
+    counts = boxes_num.astype(jnp.int32)
+    img_idx = jnp.repeat(jnp.arange(n_img), counts,
+                         total_repeat_length=boxes.shape[0])
+
+    def one_roi(box, idx):
+        feat = x[idx]
+        x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+        w = jnp.maximum(x2 - x1 + 1, 1)
+        h = jnp.maximum(y2 - y1 + 1, 1)
+
+        ys = jnp.arange(H)[None, :]
+        xs = jnp.arange(W)[None, :]
+        # bin boundaries per output cell
+        oy = jnp.arange(ph)[:, None]
+        ox = jnp.arange(pw)[:, None]
+        y_lo = y1 + jnp.floor(oy * h / ph).astype(jnp.int32)
+        y_hi = y1 + jnp.ceil((oy + 1) * h / ph).astype(jnp.int32)
+        x_lo = x1 + jnp.floor(ox * w / pw).astype(jnp.int32)
+        x_hi = x1 + jnp.ceil((ox + 1) * w / pw).astype(jnp.int32)
+        ymask = (ys >= y_lo) & (ys < jnp.maximum(y_hi, y_lo + 1))  # [ph, H]
+        xmask = (xs >= x_lo) & (xs < jnp.maximum(x_hi, x_lo + 1))  # [pw, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]      # [ph,pw,H,W]
+        big = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        out = big.max(axis=(-2, -1))                               # [C, ph, pw]
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(boxes, img_idx)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_pool_raw(x, boxes, boxes_num, output_size=tuple(output_size),
+                         spatial_scale=float(spatial_scale))
+
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Reference ``vision/ops.py nms``: greedy suppression, optionally
+    per-category; returns kept indices sorted by score."""
+    bv = boxes._value if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    n = bv.shape[0]
+    sv = (scores._value if isinstance(scores, Tensor)
+          else (jnp.asarray(scores) if scores is not None
+                else jnp.arange(n, 0, -1, dtype=jnp.float32)))
+
+    iou = _iou_matrix(bv)
+    if category_idxs is not None:
+        cv = (category_idxs._value if isinstance(category_idxs, Tensor)
+              else jnp.asarray(category_idxs))
+        same = cv[:, None] == cv[None, :]
+        iou = jnp.where(same, iou, 0.0)  # suppress only within a category
+
+    order = jnp.argsort(-sv)
+
+    def body(i, keep):
+        bi = order[i]
+        # kept higher-scoring boxes that overlap bi too much suppress it
+        sup = jnp.any(keep & (iou[bi, order] > iou_threshold)
+                      & (jnp.arange(n) < i))
+        return keep.at[i].set(~sup)
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    kept = order[jnp.nonzero(keep_sorted, size=n, fill_value=-1)[0]]
+    kept = kept[keep_sorted.sum().astype(jnp.int32) > jnp.arange(n)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(kept.astype(jnp.int64))
+
+
+@op("deform_conv2d")
+def _deform_conv2d_raw(x, offset, weight, bias=None, mask=None, stride=1,
+                       padding=0, dilation=1):
+    """Deformable conv v1/v2 (mask=None → v1). x [N,C,H,W]; offset
+    [N, 2*kh*kw, Ho, Wo]; weight [Co, C, kh, kw]; mask [N, kh*kw, Ho, Wo]."""
+    N, C, H, W = x.shape
+    Co, _, kh, kw = weight.shape
+    s, p, dil = stride, padding, dilation
+    Ho = (H + 2 * p - dil * (kh - 1) - 1) // s + 1
+    Wo = (W + 2 * p - dil * (kw - 1) - 1) // s + 1
+
+    xp = jnp.pad(x, ((0, 0), (0, 0), (p, p), (p, p)))
+
+    base_y = jnp.arange(Ho) * s
+    base_x = jnp.arange(Wo) * s
+    ky = jnp.arange(kh) * dil
+    kx = jnp.arange(kw) * dil
+
+    def one_image(img, off, mk):
+        # off [2*kh*kw, Ho, Wo] ordered (y0,x0,y1,x1,...) per kernel position
+        off = off.reshape(kh * kw, 2, Ho, Wo)
+
+        def one_kpos(kidx):
+            i, j = kidx // kw, kidx % kw
+            gy = base_y[:, None] + ky[i] + off[kidx, 0]
+            gx = base_x[None, :] + kx[j] + off[kidx, 1]
+            v = _bilinear(img, gy, gx)                  # [C, Ho, Wo]
+            if mk is not None:
+                v = v * mk[kidx]
+            return v
+
+        cols = jax.vmap(one_kpos)(jnp.arange(kh * kw))  # [kh*kw, C, Ho, Wo]
+        return cols
+
+    cols = jax.vmap(one_image)(xp, offset,
+                               mask if mask is not None else
+                               jnp.ones((N, kh * kw, Ho, Wo), x.dtype))
+    # [N, kh*kw, C, Ho, Wo] x [Co, C, kh, kw] -> [N, Co, Ho, Wo]
+    w2 = weight.transpose(0, 2, 3, 1).reshape(Co, kh * kw, C)
+    out = jnp.einsum("nkchw,okc->nohw", cols, w2)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Reference ``vision/ops.py deform_conv2d`` (v1 without mask, v2 with).
+    deformable_groups/groups > 1 are not supported yet (raises)."""
+    if deformable_groups != 1 or groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: deformable_groups/groups > 1 not supported")
+
+    def _square(v, what):
+        if isinstance(v, int):
+            return v
+        v = tuple(v)
+        if len(set(v)) != 1:
+            raise NotImplementedError(
+                f"deform_conv2d: non-square {what}={v} not supported")
+        return v[0]
+
+    s = _square(stride, "stride")
+    p = _square(padding, "padding")
+    d = _square(dilation, "dilation")
+    args = (x, offset, weight) + ((bias,) if bias is not None else ())
+    if bias is None and mask is None:
+        return _deform_conv2d_raw(x, offset, weight, stride=s, padding=p,
+                                  dilation=d)
+    return _deform_conv2d_raw(x, offset, weight, bias, mask, stride=s,
+                              padding=p, dilation=d)
+
+
+from ..nn.layer.layers import Layer as _Layer
+
+
+class DeformConv2D(_Layer):
+    """Layer form (reference ``vision/ops.py DeformConv2D``)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        kw = kernel_size if isinstance(kernel_size, int) else kernel_size[-1]
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self.weight = self.create_parameter(
+            [out_channels, in_channels, kh, kw], attr=weight_attr)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation, mask=mask)

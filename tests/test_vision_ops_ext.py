@@ -1,0 +1,146 @@
+"""vision.ops (roi_align/roi_pool/nms/deform_conv2d) + dlpack interop +
+custom-op registration. References: python/paddle/vision/ops.py,
+framework/dlpack_tensor.cc, framework/custom_operator.cc."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.vision import ops as vops
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_roi_align_against_torchvision():
+    tv = pytest.importorskip("torchvision")
+    import torch
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 16, 16).astype(np.float32)
+    boxes = np.array([[1.0, 1.0, 9.0, 9.0], [2.0, 3.0, 14.0, 12.0],
+                      [0.0, 0.0, 15.0, 15.0]], np.float32)
+    boxes_num = np.array([2, 1], np.int32)
+
+    out = vops.roi_align(Tensor(x), Tensor(boxes), Tensor(boxes_num),
+                         output_size=4, spatial_scale=1.0, sampling_ratio=2,
+                         aligned=True)
+
+    tv_boxes = [torch.tensor(boxes[:2]), torch.tensor(boxes[2:])]
+    ref = tv.ops.roi_align(torch.tensor(x), tv_boxes, output_size=4,
+                           spatial_scale=1.0, sampling_ratio=2, aligned=True)
+    np.testing.assert_allclose(_np(out), ref.numpy(), atol=1e-4)
+
+
+def test_roi_align_gradient_flows():
+    x = Tensor(np.random.RandomState(1).randn(1, 2, 8, 8).astype(np.float32),
+               stop_gradient=False)
+    boxes = Tensor(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = vops.roi_align(x, boxes, Tensor(np.array([1], np.int32)),
+                         output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and np.abs(_np(x.grad)).sum() > 0
+
+
+def test_roi_pool_basic():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 5, 6] = 7.0
+    out = vops.roi_pool(Tensor(x), Tensor(np.array([[0., 0., 7., 7.]], np.float32)),
+                        Tensor(np.array([1], np.int32)), output_size=2)
+    o = _np(out)[0, 0]
+    assert o[0, 0] == 5.0 and o[1, 1] == 7.0
+
+
+def test_nms_matches_torchvision():
+    tv = pytest.importorskip("torchvision")
+    import torch
+
+    rng = np.random.RandomState(2)
+    n = 30
+    xy = rng.uniform(0, 20, (n, 2)).astype(np.float32)
+    wh = rng.uniform(2, 8, (n, 2)).astype(np.float32)
+    boxes = np.concatenate([xy, xy + wh], -1)
+    scores = rng.uniform(0, 1, n).astype(np.float32)
+
+    kept = _np(vops.nms(Tensor(boxes), 0.4, scores=Tensor(scores)))
+    ref = tv.ops.nms(torch.tensor(boxes), torch.tensor(scores), 0.4).numpy()
+    np.testing.assert_array_equal(kept, ref)
+
+
+def test_nms_categories_and_topk():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    cats = np.array([0, 0, 1], np.int64)
+    kept = _np(vops.nms(Tensor(boxes), 0.5, scores=Tensor(scores),
+                        category_idxs=Tensor(cats), categories=[0, 1]))
+    # box1 suppressed by box0 (same cat, IoU>0.5); box2 survives (other cat)
+    assert set(kept.tolist()) == {0, 2}
+    kept2 = _np(vops.nms(Tensor(boxes), 0.5, scores=Tensor(scores),
+                         category_idxs=Tensor(cats), categories=[0, 1],
+                         top_k=1))
+    assert kept2.tolist() == [0]
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets, deform_conv2d == plain conv2d."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = (rng.randn(4, 2, 3, 3) * 0.2).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 4, 4), np.float32)
+
+    out = vops.deform_conv2d(Tensor(x), Tensor(off), Tensor(w))
+    ref = F.conv2d(Tensor(x), Tensor(w))
+    np.testing.assert_allclose(_np(out), _np(ref), atol=1e-4)
+
+    # offsets shift sampling: nonzero offset changes the output
+    off2 = np.full_like(off, 0.7)
+    out2 = vops.deform_conv2d(Tensor(x), Tensor(off2), Tensor(w))
+    assert not np.allclose(_np(out2), _np(out))
+
+
+def test_dlpack_roundtrip_with_torch():
+    import torch
+
+    from paddle_tpu.utils.dlpack import from_dlpack, to_dlpack
+
+    x = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = torch.from_dlpack(x._value)
+    np.testing.assert_allclose(t.numpy(), _np(x))
+
+    back = from_dlpack(torch.arange(4).float())
+    np.testing.assert_allclose(_np(back), [0, 1, 2, 3])
+    with pytest.raises(TypeError):
+        to_dlpack(np.zeros(3))
+
+
+def test_register_custom_op():
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils.cpp_extension import CustomOpError, register_custom_op
+
+    myop = register_custom_op("test_swish3", lambda x: x * jnp.tanh(x))
+    x = Tensor(np.array([0.5, -1.0], np.float32), stop_gradient=False)
+    y = myop(x)
+    y.sum().backward()
+    assert x.grad is not None
+
+    # custom backward pair
+    def save(x):
+        return x * 2.0, x
+
+    def grad(res, g):
+        return (g * 2.0,)
+
+    dbl = register_custom_op("test_double3", lambda x: x * 2.0,
+                             backward=(save, grad))
+    x2 = Tensor(np.ones(3, np.float32), stop_gradient=False)
+    dbl(x2).sum().backward()
+    np.testing.assert_allclose(_np(x2.grad), 2.0)
+
+    with pytest.raises(CustomOpError):
+        register_custom_op("test_swish3", lambda x: x)
