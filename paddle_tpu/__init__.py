@@ -80,6 +80,7 @@ from . import static  # noqa: F401
 from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
 from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.model_summary import summary  # noqa: F401
 from .framework.io import load, save  # noqa: F401

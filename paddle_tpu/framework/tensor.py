@@ -50,6 +50,12 @@ class Tensor:
             value = jnp.asarray(value)
             if place is not None:
                 value = jax.device_put(value, place.jax_device())
+        self._init_fields(value, stop_gradient=stop_gradient, name=name)
+
+    def _init_fields(self, value, stop_gradient=True, name=None):
+        """Single source of truth for the private field set — used by
+        subclasses that hold non-array values (static Variable's
+        ShapeDtypeStruct, sparse tensors' BCOO/BCSR)."""
         self._value = value
         self.stop_gradient = stop_gradient
         self._grad = None
@@ -187,6 +193,16 @@ class Tensor:
             self._grad = None
 
     clear_gradient = clear_grad
+
+    # sparse-type predicates (paddle surface): dense tensors answer False
+    def is_sparse(self):
+        return False
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return False
 
     def detach(self):
         t = Tensor(self._value, stop_gradient=True, name=self.name)

@@ -35,11 +35,10 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
     if dropout_p > 0.0 and training:
         return False
     sq, sk = q_shape[1], k_shape[1]
-    # At short sequence lengths XLA's fused einsum attention beats the Pallas
-    # kernel on-chip (measured: GPT-2 s=1024 fwd 59 ms vs 75 ms) because the
-    # [sq, sk] logits fit HBM comfortably and d=64 half-fills the MXU
-    # contraction; the flash kernel pays off once the materialized logits
-    # (and their saved softmax residuals) stop fitting.
+    # Routing by measured crossover (v5e): below sq*sk = 1024^2 XLA's fused
+    # einsum attention wins; at 1024^2+ the Pallas kernel with 1024-wide
+    # blocks is faster (GPT-2 s=1024 end-to-end: 102.6k vs 88.0k tok/s) and
+    # keeps memory flat at long context.
     if sq * sk < flag_value("flash_attention_min_seq_prod") and not pallas.interpret_requested():
         return False
     if mask is not None:

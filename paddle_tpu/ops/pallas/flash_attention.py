@@ -31,8 +31,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Measured on v5e (GPT-2 shapes, d=64): 1024x1024 tiles are ~2x faster than
+# 512x512 and ~9x faster than 256x256 at s=4096 (fwd+bwd), and beat XLA's
+# fused einsum attention at s=1024 (102.6k vs 88.0k tok/s end-to-end GPT
+# training). Bigger tiles exceed VMEM. Override via
+# FLAGS_flash_attention_block_{q,k}.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 LANES = 128
 STAT_LANES = 8  # sublane-oriented row-stat arrays
 NEG_INF = -1e30

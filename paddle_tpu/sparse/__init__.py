@@ -1,0 +1,193 @@
+"""paddle.sparse — SparseCooTensor / SparseCsrTensor surface.
+
+Reference: ``paddle/phi/core/sparse_coo_tensor.h`` /
+``sparse_csr_tensor.h``, kernels ``phi/kernels/sparse/``, python API
+``python/paddle/incubate/sparse/``. TPU-native: backed by
+``jax.experimental.sparse`` BCOO/BCSR, whose matmuls lower to XLA
+gather/scatter-free dot products where possible.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "matmul", "add", "multiply", "relu", "to_dense",
+    "is_same_shape",
+]
+
+
+class _SparseBase(Tensor):
+    """A Tensor whose _value is a jax sparse array; dense ops should call
+    .to_dense() first (mirrors the reference's separate sparse kernels)."""
+
+    def __init__(self, mat):
+        self._init_fields(mat)
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    def is_sparse(self):
+        return True
+
+    def to_dense(self):
+        return Tensor(self._value.todense())
+
+    def numpy(self):
+        return np.asarray(self._value.todense())
+
+    def nnz(self):
+        return int(self._value.nse)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
+class SparseCooTensor(_SparseBase):
+    """Reference ``sparse_coo_tensor.h``: COO layout."""
+
+    def is_sparse_csr(self):
+        return False
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._value.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._value.data)
+
+    def is_sparse_coo(self):
+        return True
+
+    def coalesce(self):
+        return SparseCooTensor(self._value.sum_duplicates())
+
+
+class SparseCsrTensor(_SparseBase):
+    """Reference ``sparse_csr_tensor.h``: CSR layout."""
+
+    def is_sparse_coo(self):
+        return False
+
+    def crows(self):
+        return Tensor(self._value.indptr)
+
+    def cols(self):
+        return Tensor(self._value.indices)
+
+    def values(self):
+        return Tensor(self._value.data)
+
+    def is_sparse_csr(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Reference ``incubate/sparse/creation.py sparse_coo_tensor``:
+    indices [ndim, nnz], values [nnz]."""
+    idx = np.asarray(indices._value if isinstance(indices, Tensor) else indices)
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values,
+                      dtype)
+    if shape is None:
+        if idx.size == 0:
+            raise ValueError(
+                "sparse_coo_tensor with empty indices needs an explicit shape")
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    mat = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(mat)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Reference ``sparse_csr_tensor``: CSR triplet."""
+    cr = jnp.asarray(crows._value if isinstance(crows, Tensor) else crows,
+                     jnp.int32)
+    cl = jnp.asarray(cols._value if isinstance(cols, Tensor) else cols,
+                     jnp.int32)
+    val = jnp.asarray(values._value if isinstance(values, Tensor) else values,
+                      dtype)
+    mat = jsparse.BCSR((val, cl, cr), shape=tuple(shape))
+    return SparseCsrTensor(mat)
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_like(mat):
+    if isinstance(mat, jsparse.BCOO):
+        return SparseCooTensor(mat)
+    if isinstance(mat, jsparse.BCSR):
+        return SparseCsrTensor(mat)
+    return Tensor(mat)
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, _SparseBase) else x
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (reference ``sparse/matmul``)."""
+    xv, yv = _unwrap(x), _unwrap(y)
+    return Tensor(xv @ yv)
+
+
+def _as_bcoo(v):
+    if isinstance(v, jsparse.BCOO):
+        return v
+    if isinstance(v, jsparse.BCSR):
+        return v.to_bcoo()
+    return None
+
+
+def add(x, y, name=None):
+    """sparse+sparse stays sparse (CSR operands go through BCOO and come
+    back as CSR, matching the reference's layout-preserving add)."""
+    xv, yv = _unwrap(x), _unwrap(y)
+    xs, ys = _as_bcoo(xv), _as_bcoo(yv)
+    if xs is not None and ys is not None:
+        out = (xs + ys).sum_duplicates()
+        if isinstance(xv, jsparse.BCSR) and isinstance(yv, jsparse.BCSR):
+            return SparseCsrTensor(jsparse.BCSR.from_bcoo(out))
+        return SparseCooTensor(out)
+    return Tensor(
+        (xv.todense() if hasattr(xv, "todense") else xv)
+        + (yv.todense() if hasattr(yv, "todense") else yv)
+    )
+
+
+def multiply(x, y, name=None):
+    xv, yv = _unwrap(x), _unwrap(y)
+    if isinstance(xv, jsparse.BCOO) and not hasattr(yv, "todense"):
+        # sparse * dense: scale stored values by gathered dense entries;
+        # scalars / broadcastable shapes are broadcast to x's shape first
+        dense = jnp.broadcast_to(jnp.asarray(yv), xv.shape)
+        dense_at = dense[tuple(xv.indices.T)]
+        return SparseCooTensor(jsparse.BCOO((xv.data * dense_at, xv.indices),
+                                            shape=xv.shape))
+    return Tensor((xv.todense() if hasattr(xv, "todense") else xv)
+                  * (yv.todense() if hasattr(yv, "todense") else yv))
+
+
+def relu(x, name=None):
+    """Elementwise on stored values only (sparsity preserved) — the
+    reference sparse relu semantics."""
+    v = _unwrap(x)
+    if isinstance(v, jsparse.BCOO):
+        return SparseCooTensor(jsparse.BCOO((jnp.maximum(v.data, 0), v.indices),
+                                            shape=v.shape))
+    if isinstance(v, jsparse.BCSR):
+        return SparseCsrTensor(
+            jsparse.BCSR((jnp.maximum(v.data, 0), v.indices, v.indptr),
+                         shape=v.shape))
+    return Tensor(jnp.maximum(v, 0))
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)

@@ -87,6 +87,25 @@ class Executor:
                                  params, opts)
             self._cache[key] = runner
 
+        # validate fed shapes against declarations: dynamic dims (None/-1)
+        # accept any size (each new size retraces the jitted replay — an
+        # explicit bucketing policy); static dims must match exactly
+        for k in sorted(feed.keys()):
+            ph = program.placeholders.get(k)
+            if ph is None or not hasattr(ph, "_declared_shape"):
+                continue
+            v = feed[k]
+            got = tuple(v.shape) if hasattr(v, "shape") else np.shape(v)
+            decl = ph._declared_shape
+            if len(got) != len(decl) or any(
+                d not in (None, -1) and int(d) != g
+                for d, g in zip(decl, got)
+            ):
+                raise ValueError(
+                    f"feed {k!r} has shape {tuple(got)}, declared "
+                    f"{tuple(decl)} (None/-1 dims are dynamic, the rest "
+                    "must match)"
+                )
         feed_vals = [jnp.asarray(feed[k]) for k in sorted(feed.keys())]
         param_vals = [p._value for p in params]
         opt_states = [o._state_pytree() for o in opts]

@@ -32,16 +32,9 @@ class Variable(Tensor):
             tuple(1 if s in (None, -1) else int(s) for s in shape),
             dtypes.convert_dtype(dtype),
         )
-        # field-by-field init: Tensor.__init__ would jnp.asarray the struct
-        self._value = struct
-        self.stop_gradient = stop_gradient
-        self._grad = None
-        self._grad_node = None
-        self._out_slot = 0
-        self._hooks = []
-        self.persistable = False
-        self.is_leaf_param = False
-        self.name = name or f"var_{next(_name_counter)}"
+        # _init_fields: Tensor.__init__ would jnp.asarray the struct
+        self._init_fields(struct, stop_gradient=stop_gradient,
+                          name=name or f"var_{next(_name_counter)}")
         self._declared_shape = list(shape)
         self.program = program
 

@@ -1,0 +1,79 @@
+"""paddle.sparse: COO/CSR creation, matmul/add/multiply/relu, dense
+round-trips. Reference: phi/core/sparse_*_tensor.h, kernels/sparse/,
+python/paddle/incubate/sparse/."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.framework.tensor import Tensor
+
+
+def test_coo_roundtrip_and_accessors():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert s.is_sparse() and s.is_sparse_coo()
+    assert s.shape == [3, 3] and s.nnz() == 3
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(np.asarray(s.values()._value), values)
+    np.testing.assert_allclose(np.asarray(s.indices()._value), indices)
+
+
+def test_csr_roundtrip():
+    s = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0],
+                                 shape=[3, 3])
+    assert s.is_sparse_csr()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(s.to_dense().numpy(), expect)
+    np.testing.assert_allclose(np.asarray(s.crows()._value), [0, 1, 2, 3])
+
+
+def test_sparse_dense_matmul():
+    rng = np.random.RandomState(0)
+    dense = rng.randn(4, 4).astype(np.float32)
+    dense[dense < 0.3] = 0.0
+    idx = np.nonzero(dense)
+    s = sparse.sparse_coo_tensor(np.stack(idx), dense[idx], shape=dense.shape)
+    y = rng.randn(4, 5).astype(np.float32)
+    out = sparse.matmul(s, Tensor(y))
+    np.testing.assert_allclose(np.asarray(out._value), dense @ y, atol=1e-5)
+
+
+def test_add_multiply_relu():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [-1.0, 2.0], shape=[2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [5.0, -7.0], shape=[2, 2])
+    s = sparse.add(a, b)
+    np.testing.assert_allclose(s.to_dense().numpy(), [[4, 0], [0, -5]])
+    r = sparse.relu(a)
+    np.testing.assert_allclose(r.to_dense().numpy(), [[0, 0], [0, 2]])
+    d = Tensor(np.full((2, 2), 3.0, np.float32))
+    m = sparse.multiply(a, d)
+    np.testing.assert_allclose(m.to_dense().numpy(), [[-3, 0], [0, 6]])
+    assert sparse.is_same_shape(a, b)
+
+
+def test_review_fixes_predicates_csr_add_scalar_multiply():
+    dense = Tensor(np.ones((2, 2), np.float32))
+    assert not dense.is_sparse() and not dense.is_sparse_coo()
+    a = sparse.sparse_coo_tensor([[0], [0]], [1.0], shape=[2, 2])
+    assert a.is_sparse_coo() and not a.is_sparse_csr()
+
+    c1 = sparse.sparse_csr_tensor([0, 1, 1], [0], [1.0], shape=[2, 2])
+    c2 = sparse.sparse_csr_tensor([0, 0, 1], [1], [2.0], shape=[2, 2])
+    s = sparse.add(c1, c2)
+    assert s.is_sparse_csr()
+    np.testing.assert_allclose(s.to_dense().numpy(), [[1, 0], [0, 2]])
+
+    m = sparse.multiply(a, 2.0)
+    np.testing.assert_allclose(m.to_dense().numpy(), [[2, 0], [0, 0]])
+    row = Tensor(np.array([3.0, 4.0], np.float32))
+    m2 = sparse.multiply(a, row)
+    np.testing.assert_allclose(m2.to_dense().numpy(), [[3, 0], [0, 0]])
+
+    with pytest.raises(ValueError, match="explicit shape"):
+        sparse.sparse_coo_tensor(np.zeros((2, 0)), np.zeros((0,)))

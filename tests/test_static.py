@@ -177,3 +177,39 @@ def test_save_load_inference_model(tmp_path):
     exe = static.Executor()
     (want,) = exe.run(main, feed={"x": x_np}, fetch_list=[out])
     np.testing.assert_allclose(np.asarray(got._value), want, rtol=1e-5)
+
+
+def test_dynamic_batch_dim_retraces_correctly():
+    """VERDICT weak #8: None/-1 dims are dynamic — different batch sizes
+    run correctly (each size is its own compiled bucket), and mismatched
+    STATIC dims raise instead of silently mis-shaping."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [-1, 8], "float32")
+            lin = nn.Linear(8, 3)
+            y = (lin(x) * 2.0).sum(axis=1)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        for bs in (4, 7, 4):
+            (out,) = exe.run(main, feed={"x": np.ones((bs, 8), np.float32)},
+                             fetch_list=[y.name])
+            assert out.shape == (bs,), out.shape
+        # static dim mismatch raises
+        import pytest
+
+        with pytest.raises(ValueError, match="declared"):
+            exe.run(main, feed={"x": np.ones((4, 9), np.float32)},
+                    fetch_list=[y.name])
+        with pytest.raises(ValueError, match="declared"):
+            exe.run(main, feed={"x": np.ones((4,), np.float32)},
+                    fetch_list=[y.name])
+    finally:
+        paddle.disable_static()
