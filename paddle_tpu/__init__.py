@@ -81,8 +81,12 @@ from . import profiler  # noqa: F401
 from . import hapi  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
+from . import incubate  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi.model import Model  # noqa: F401
-from .hapi.model_summary import summary  # noqa: F401
+from .hapi.model_summary import summary, flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 
 _static_mode = False

@@ -7,7 +7,7 @@ import numpy as np
 from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["summary"]
+__all__ = ["summary", "flops"]
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
@@ -75,3 +75,64 @@ def summary(net, input_size=None, dtypes=None, input=None):
     print(f"Non-trainable params: {total - trainable:,}")
     print("-" * width)
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Reference ``hapi/dynamic_flops.py flops``: per-layer FLOP count via
+    forward hooks (multiply-accumulate counted as 2 ops, matching the
+    reference's conventions for Conv2D/Linear)."""
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    from ..nn.layer.norm import BatchNorm2D, LayerNorm
+
+    counts = {"total": 0}
+    rows = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def count(layer, ins, out):
+        x = ins[0] if isinstance(ins, (tuple, list)) else ins
+        o = out[0] if isinstance(out, (tuple, list)) else out
+        n = 0
+        t = type(layer)
+        if t in custom_ops:
+            n = int(custom_ops[t](layer, x, o))
+        elif isinstance(layer, Conv2D):
+            kh, kw = layer._kernel_size if isinstance(layer._kernel_size, (tuple, list)) else (layer._kernel_size,) * 2
+            cin = layer.weight.shape[1]
+            cout, hh, ww = o.shape[1], o.shape[-2], o.shape[-1]
+            n = 2 * cin * kh * kw * cout * hh * ww * o.shape[0]
+        elif isinstance(layer, Linear):
+            # weight is [in_features, out_features]
+            n = (2 * int(np.prod(x.shape[:-1]))
+                 * layer.weight.shape[0] * layer.weight.shape[-1])
+        elif isinstance(layer, (BatchNorm2D, LayerNorm)):
+            n = 2 * int(np.prod(o.shape))
+        if n:
+            counts["total"] += n
+            rows.append((type(layer).__name__, n))
+
+    def register(layer):
+        for sub in layer.sublayers(include_self=True):
+            if not sub._sub_layers:
+                hooks.append(sub.register_forward_post_hook(count))
+
+    register(net)
+    sizes = input_size if isinstance(input_size, (tuple, list)) else [input_size]
+    if isinstance(sizes[0], int):
+        sizes = [sizes]
+    inputs = [Tensor(np.zeros(s, np.float32)) for s in sizes]
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        for name, n in rows:
+            print(f"{name:<24}{n:>16,}")
+        print(f"Total FLOPs: {counts['total']:,}")
+    return counts["total"]

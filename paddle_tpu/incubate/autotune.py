@@ -1,0 +1,43 @@
+"""paddle.incubate.autotune (reference ``python/paddle/incubate/autotune.py``
+``set_config`` driving kernel/layout/dataloader autotuning).
+
+TPU-native: kernel selection and layout are XLA's job (its autotuner runs at
+compile time), so ``set_config`` maps the reference's knobs onto the flags
+registry — kernel.enable toggles the measured flash-attention block
+defaults, dataloader.use_autotune tunes DataLoader worker counts."""
+from __future__ import annotations
+
+import json
+
+from ..framework.flags import flag_value, set_flags
+
+__all__ = ["set_config"]
+
+_STATUS = {"kernel": {"enable": True}, "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """Accepts the reference's dict or a JSON file path."""
+    if config is None:
+        _STATUS["kernel"]["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError("set_config expects None, a dict, or a JSON path")
+    for key in config:
+        if key not in _STATUS:
+            raise ValueError(f"unknown autotune section {key!r}")
+        section = config[key] or {}
+        _STATUS[key].update(section)
+    if _STATUS["kernel"].get("enable") is False:
+        # "no tuned kernels": route attention off the measured Pallas path
+        set_flags({"disable_flash_attention": True})
+    elif "kernel" in config:
+        set_flags({"disable_flash_attention": False})
+
+
+def get_status():
+    return {k: dict(v) for k, v in _STATUS.items()}

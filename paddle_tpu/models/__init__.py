@@ -9,6 +9,14 @@ from .gpt import (  # noqa: F401
     GPTEmbeddings,
     build_gpt_pipeline_descs,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    BertForPretraining,
+    BertForSequenceClassification,
+    bert_base,
+    bert_large,
+)
 
 __all__ = [
     "GPTConfig",
@@ -17,4 +25,10 @@ __all__ = [
     "GPTDecoderLayer",
     "GPTEmbeddings",
     "build_gpt_pipeline_descs",
+    "BertConfig",
+    "BertModel",
+    "BertForPretraining",
+    "BertForSequenceClassification",
+    "bert_base",
+    "bert_large",
 ]

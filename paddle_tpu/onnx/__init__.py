@@ -1,0 +1,33 @@
+"""paddle.onnx (reference ``python/paddle/onnx/export.py`` — paddle2onnx).
+
+TPU-native export story: the portable artifact is StableHLO via
+``paddle.jit.save`` (jit/save_load.py), which MLIR-consuming toolchains
+ingest directly. ``export`` performs that export at the requested path; an
+actual ``.onnx`` conversion additionally requires the optional
+``paddle2onnx``/``onnx`` packages (not present in this environment), and
+raises a clear error for that step only.
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Exports ``layer`` as StableHLO + weights at ``path`` (always), then
+    attempts the ONNX conversion when the onnx package is available."""
+    from ..jit.save_load import save as jit_save
+
+    jit_save(layer, path, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        warnings.warn(
+            "onnx is not installed: exported StableHLO + weights at "
+            f"{path!r} (.pdmodel/.pdiparams); install onnx/paddle2onnx for "
+            ".onnx output", stacklevel=2)
+        return path
+    raise NotImplementedError(
+        "StableHLO->ONNX conversion is not wired; the StableHLO export at "
+        f"{path!r} succeeded")

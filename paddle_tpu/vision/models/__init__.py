@@ -1,4 +1,13 @@
 from .lenet import LeNet  # noqa: F401
+from .alexnet import AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+)
 from .resnet import (  # noqa: F401
     ResNet,
     resnet18,

@@ -1,0 +1,110 @@
+"""AlexNet + SqueezeNet (reference ``python/paddle/vision/models/
+{alexnet,squeezenet}.py``)."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1"]
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(dropout), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = F.adaptive_avg_pool2d(x, output_size=6)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(start_axis=1))
+        return x
+
+
+def alexnet(pretrained=False, **kw):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return AlexNet(**kw)
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        from ... import ops
+
+        x = F.relu(self.squeeze(x))
+        return ops.concat([F.relu(self.expand1(x)), F.relu(self.expand3(x))],
+                          axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """Reference ``squeezenet.py`` (version "1.0"/"1.1")."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError("version must be 1.0 or 1.1")
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.stem = nn.Conv2D(3, 96, 7, stride=2)
+            fires = [_Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                     _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            self._pool_after = {0: False, 2: True, 6: True}
+        else:
+            self.stem = nn.Conv2D(3, 64, 3, stride=2, padding=1)
+            fires = [_Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                     _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                     _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256)]
+            self._pool_after = {1: True, 3: True}
+        self.fires = nn.LayerList(fires)
+        self.final_conv = nn.Conv2D(512, num_classes, 1)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.stem(x)), kernel_size=3, stride=2)
+        for i, fire in enumerate(self.fires):
+            x = fire(x)
+            if self._pool_after.get(i):
+                x = F.max_pool2d(x, kernel_size=3, stride=2)
+        x = F.relu(self.final_conv(x))
+        if self.with_pool:
+            x = F.adaptive_avg_pool2d(x, output_size=1)
+        return x.flatten(start_axis=1)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return SqueezeNet("1.1", **kw)

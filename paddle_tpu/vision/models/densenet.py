@@ -1,0 +1,133 @@
+"""DenseNet (reference ``python/paddle/vision/models/densenet.py``)."""
+from __future__ import annotations
+
+from ... import nn
+from ...nn import functional as F
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(num_channels)
+        self.conv1 = nn.Conv2D(num_channels, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = dropout
+
+    def forward(self, x):
+        y = self.conv1(F.relu(self.bn1(x)))
+        y = self.conv2(F.relu(self.bn2(y)))
+        if self.dropout:
+            y = F.dropout(y, p=self.dropout, training=self.training)
+        from ... import ops
+
+        return ops.concat([x, y], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, num_channels, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(num_channels + i * growth_rate, growth_rate, bn_size,
+                        dropout)
+            for i in range(num_layers)
+        ])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, num_channels, num_out):
+        super().__init__()
+        self.bn = nn.BatchNorm2D(num_channels)
+        self.conv = nn.Conv2D(num_channels, num_out, 1, bias_attr=False)
+
+    def forward(self, x):
+        x = self.conv(F.relu(self.bn(x)))
+        return F.avg_pool2d(x, kernel_size=2, stride=2)
+
+
+_CFGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class DenseNet(nn.Layer):
+    """Reference ``densenet.py DenseNet(layers=121, ...)``."""
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFGS:
+            raise ValueError(f"layers must be one of {sorted(_CFGS)}")
+        init_ch, growth, blocks = _CFGS[layers]
+        self.stem_conv = nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                                   bias_attr=False)
+        self.stem_bn = nn.BatchNorm2D(init_ch)
+        ch = init_ch
+        dense, trans = [], []
+        for i, n in enumerate(blocks):
+            dense.append(_DenseBlock(n, ch, growth, bn_size, dropout))
+            ch += n * growth
+            if i != len(blocks) - 1:
+                trans.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.dense_blocks = nn.LayerList(dense)
+        self.transitions = nn.LayerList(trans)
+        self.final_bn = nn.BatchNorm2D(ch)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.stem_bn(self.stem_conv(x))
+        x = F.max_pool2d(F.relu(x), kernel_size=3, stride=2, padding=1)
+        for i, block in enumerate(self.dense_blocks):
+            x = block(x)
+            if i < len(self.transitions):
+                x = self.transitions[i](x)
+        x = F.relu(self.final_bn(x))
+        if self.with_pool:
+            x = F.adaptive_avg_pool2d(x, output_size=1)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(start_axis=1))
+        return x
+
+
+def _make(layers, pretrained=False, **kw):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled")
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _make(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _make(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _make(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _make(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _make(264, pretrained, **kw)
