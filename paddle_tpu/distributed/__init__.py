@@ -46,6 +46,7 @@ from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import elastic  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,103 @@
+"""Elastic training (reference ``python/paddle/distributed/elastic.py``
+ElasticManager — etcd3 registration/heartbeat/watch, ``elastic.py:23-45``).
+
+TPU-native redesign: TPU slices are fixed-topology (a pod slice cannot gain
+chips mid-job), so "elastic" on TPU means FAULT RECOVERY, not live resize:
+the launcher (``distributed/launch``) restarts failed rank groups up to
+``--max_restart`` with a fresh rendezvous, and this module provides the
+reference's manager surface over a shared-filesystem heartbeat registry
+(etcd's role; a pod's shared NFS/GCS mount in practice) so trainers can
+detect dead peers and trigger the restart path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """File-registry membership manager. ``elastic_dir`` plays etcd's role:
+    each rank writes ``rank<i>.json`` heartbeats; ``watch`` reports RESTART
+    when a peer goes stale and EXIT/COMPLETED on clean shutdown."""
+
+    def __init__(self, args=None, elastic_dir=None, rank=None, world_size=None,
+                 timeout=30.0):
+        env = os.environ
+        self.elastic_dir = (elastic_dir
+                            or env.get("PADDLE_ELASTIC_DIR")
+                            or os.path.join("/tmp", "paddle_elastic",
+                                            env.get("PADDLE_JOB_ID", "default")))
+        self.rank = int(rank if rank is not None
+                        else env.get("PADDLE_TRAINER_ID", 0))
+        self.world_size = int(world_size if world_size is not None
+                              else env.get("PADDLE_TRAINERS_NUM", 1))
+        self.timeout = float(timeout)
+        self.enable = self.world_size > 1 or elastic_dir is not None
+        os.makedirs(self.elastic_dir, exist_ok=True)
+        self._hb_path = os.path.join(self.elastic_dir, f"rank{self.rank}.json")
+
+    # -- registration / heartbeat (≙ etcd keepalive) -------------------------
+    def register(self):
+        self.heartbeat()
+
+    def heartbeat(self, status="running"):
+        tmp = self._hb_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "ts": time.time(),
+                       "status": status}, f)
+        os.replace(tmp, self._hb_path)
+
+    def exit(self, completed=True):
+        self.heartbeat(ElasticStatus.COMPLETED if completed
+                       else ElasticStatus.ERROR)
+
+    # -- membership view ------------------------------------------------------
+    def _peers(self):
+        out = {}
+        for name in os.listdir(self.elastic_dir):
+            if name.startswith("rank") and name.endswith(".json"):
+                try:
+                    with open(os.path.join(self.elastic_dir, name)) as f:
+                        d = json.load(f)
+                    out[int(d["rank"])] = d
+                except (OSError, ValueError, KeyError):
+                    pass
+        return out
+
+    def world(self):
+        return sorted(self._peers())
+
+    def watch(self):
+        """One poll of the membership (reference's watch loop body):
+        COMPLETED when every peer finished cleanly, RESTART when any peer is
+        in error or stale past the timeout, HOLD while peers are still
+        arriving, ``None`` while everyone is healthy (keep training).
+
+        Matches the reference loop contract
+        (``fleet/elastic/__init__.py:77``): EXIT/COMPLETED terminate the
+        job, so a healthy poll must NOT return EXIT."""
+        peers = self._peers()
+        now = time.time()
+        if len(peers) < self.world_size:
+            return ElasticStatus.HOLD
+        statuses = [p.get("status") for p in peers.values()]
+        if all(s == ElasticStatus.COMPLETED for s in statuses):
+            return ElasticStatus.COMPLETED
+        for p in peers.values():
+            if p.get("status") == ElasticStatus.ERROR:
+                return ElasticStatus.RESTART
+            if (p.get("status") == "running"
+                    and now - float(p.get("ts", 0)) > self.timeout):
+                return ElasticStatus.RESTART
+        return None

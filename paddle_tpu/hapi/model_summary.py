@@ -79,8 +79,9 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     """Reference ``hapi/dynamic_flops.py flops``: per-layer FLOP count via
-    forward hooks (multiply-accumulate counted as 2 ops, matching the
-    reference's conventions for Conv2D/Linear)."""
+    forward hooks, using the reference's counting conventions — a
+    multiply-accumulate is ONE op, conv counts its bias add, so the numbers
+    are directly comparable with upstream ``paddle.flops`` output."""
     from ..nn.layer.common import Linear
     from ..nn.layer.conv import Conv2D
     from ..nn.layer.norm import BatchNorm2D, LayerNorm
@@ -98,14 +99,15 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         if t in custom_ops:
             n = int(custom_ops[t](layer, x, o))
         elif isinstance(layer, Conv2D):
+            # reference dynamic_flops.py count_convNd:
+            # out_numel * (cin/groups * kh * kw + bias)
             kh, kw = layer._kernel_size if isinstance(layer._kernel_size, (tuple, list)) else (layer._kernel_size,) * 2
-            cin = layer.weight.shape[1]
-            cout, hh, ww = o.shape[1], o.shape[-2], o.shape[-1]
-            n = 2 * cin * kh * kw * cout * hh * ww * o.shape[0]
+            cin_per_group = layer.weight.shape[1]
+            bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+            n = int(np.prod(o.shape)) * (cin_per_group * kh * kw + bias_ops)
         elif isinstance(layer, Linear):
-            # weight is [in_features, out_features]
-            n = (2 * int(np.prod(x.shape[:-1]))
-                 * layer.weight.shape[0] * layer.weight.shape[-1])
+            # reference count_linear: in_features * out_numel (MAC = 1 op)
+            n = layer.weight.shape[0] * int(np.prod(o.shape))
         elif isinstance(layer, (BatchNorm2D, LayerNorm)):
             n = 2 * int(np.prod(o.shape))
         if n:

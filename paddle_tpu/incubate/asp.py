@@ -1,0 +1,125 @@
+"""Automatic SParsity — 2:4 structured sparsity (reference
+``python/paddle/incubate/asp/`` — ``asp.py decorate/prune_model``,
+``supported_layer_list.py``, mask algorithms in ``utils.py``).
+
+TPU-native: the 2:4 pattern (keep the 2 largest-|w| of every 4 along the
+reduction dim) is computed as a boolean mask per supported weight;
+``prune_model`` applies it once, and a ``decorate``-wrapped optimizer
+re-applies it after every step so training stays inside the sparse support
+(the reference's OptimizerWithSparsityGuarantee). The masked multiply is a
+traced elementwise op, so ASP training jit-compiles like everything else.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density"]
+
+_EXCLUDED: set[str] = set()
+_MASKS: dict[str, jnp.ndarray] = {}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Reference ``asp.py set_excluded_layers``: skip these params."""
+    for n in param_names:
+        _EXCLUDED.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def calculate_density(x):
+    """Fraction of nonzeros (reference ``asp.py calculate_density``)."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_groups(flat: np.ndarray) -> np.ndarray:
+    """Per group of 4 along the last axis keep the top-2 |w|."""
+    cols = flat.shape[-1]
+    pad = (-cols) % 4
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    g = flat.reshape(flat.shape[0], -1, 4)
+    order = np.argsort(-np.abs(g), axis=-1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :2], True, axis=-1)
+    return mask.reshape(flat.shape)[:, :cols]
+
+
+def _mask_2to4_1d(w: np.ndarray) -> np.ndarray:
+    """mask_1d along the REDUCTION dim (what 2:4 sparse matmul hardware
+    contracts over): Linear weight is [in, out] -> groups run along `in`;
+    Conv weight is [cout, cin, kh, kw] -> groups along cin*kh*kw."""
+    if w.ndim == 2:
+        # [in, out]: reduction is axis 0
+        return _mask_groups(w.T).T
+    # conv-style [cout, ...reduction...]
+    return _mask_groups(w.reshape(w.shape[0], -1)).reshape(w.shape)
+
+
+def _supported_params(model):
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, (Linear, Conv2D)):
+            w = getattr(layer, "weight", None)
+            if w is not None and w.name not in _EXCLUDED and w.ndim >= 2:
+                yield w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Compute and apply 2:4 masks (reference ``asp.py prune_model``).
+    Returns {param_name: mask}."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    out = {}
+    for p in _supported_params(model):
+        mask = _mask_2to4_1d(np.asarray(p._value, dtype=np.float32))
+        m_arr = jnp.asarray(mask, p._value.dtype)
+        p._value = p._value * m_arr
+        if with_mask:
+            _MASKS[p.name] = m_arr
+        out[p.name] = m_arr
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every step (reference
+    ``asp.py OptimizerWithSparsityGuarantee``)."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list or []:
+            m = _MASKS.get(p.name)
+            if m is not None:
+                p._value = p._value * m
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero=set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+
+def decorate(optimizer):
+    """Reference ``asp.py decorate``."""
+    if not isinstance(optimizer, Optimizer):
+        raise TypeError("decorate expects an Optimizer")
+    return OptimizerWithSparsityGuarantee(optimizer)

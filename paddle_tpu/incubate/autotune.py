@@ -21,6 +21,7 @@ def set_config(config=None):
     """Accepts the reference's dict or a JSON file path."""
     if config is None:
         _STATUS["kernel"]["enable"] = True
+        set_flags({"disable_flash_attention": False})
         return
     if isinstance(config, str):
         with open(config) as f:

@@ -1,0 +1,158 @@
+"""paddle.nn.quant — quantization-aware training layers.
+
+Reference: ``python/paddle/nn/quant/quant_layers.py`` (FakeQuantAbsMax,
+FakeQuantMovingAverageAbsMax, QuantizedLinear/QuantizedConv2D) backed by the
+``fake_quantize_*`` CUDA kernels. TPU-native: quant-dequant is a traced
+round/clip with a straight-through-estimator custom VJP — one fused XLA
+elementwise chain — and the observers' moving state lives as layer buffers
+so QAT jit-compiles with the rest of the step.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import op
+from ..layer.layers import Layer
+from ..layer.common import Linear
+from ..layer.conv import Conv2D
+
+__all__ = [
+    "FakeQuantAbsMax",
+    "FakeQuantMovingAverageAbsMax",
+    "QuantizedLinear",
+    "QuantizedConv2D",
+    "quant_aware",
+]
+
+
+@jax.custom_vjp
+def _quant_dequant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _qd_fwd(x, scale, qmax):
+    return _quant_dequant(x, scale, qmax), (x, scale, qmax)
+
+
+def _qd_bwd(res, g):
+    x, scale, qmax = res
+    # straight-through estimator, gated to the clip range
+    inside = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_quant_dequant.defvjp(_qd_fwd, _qd_bwd)
+
+
+@op("fake_quant_abs_max")
+def _fake_quant_abs_max(x, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    return _quant_dequant(x, scale, qmax)
+
+
+@op("fake_quant_moving_abs_max")
+def _fake_quant_moving(x, state, rate=0.9, bits=8, training=True):
+    """state: [accum, scale]; returns (out, new_state)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    accum, scale = state[0], state[1]
+    new_scale = jnp.where(training, rate * scale + (1 - rate) * cur, scale)
+    out = _quant_dequant(x, jnp.where(training, cur, new_scale), qmax)
+    return out, jnp.stack([accum + 1.0, new_scale])
+
+
+class FakeQuantAbsMax(Layer):
+    """Reference ``quant_layers.py FakeQuantAbsMax``: per-tensor abs-max
+    quant-dequant with STE gradients."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        return _fake_quant_abs_max(x, bits=self.quant_bits)
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Reference FakeQuantMovingAverageAbsMax: EMA of the activation range
+    (training) frozen at eval."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+        self.state = self.create_parameter([2], default_initializer=None,
+                                           is_bias=True)
+        self.state.stop_gradient = True
+        import numpy as np
+
+        self.state._value = jnp.asarray(np.array([0.0, 1.0], np.float32))
+
+    def forward(self, x):
+        out, new_state = _fake_quant_moving(
+            x, self.state, rate=self.moving_rate, bits=self.quant_bits,
+            training=self.training)
+        self.state._value = new_state._value
+        return out
+
+
+class QuantizedLinear(Layer):
+    """Reference QuantizedLinear: fake-quant on weight + input."""
+
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(quant_bits=weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        xq = self.act_quant(x)
+        wq = self.weight_quant(self.inner.weight)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = layer
+        self.weight_quant = FakeQuantAbsMax(quant_bits=weight_bits)
+        self.act_quant = FakeQuantMovingAverageAbsMax(
+            moving_rate=moving_rate, quant_bits=activation_bits)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        xq = self.act_quant(x)
+        wq = self.weight_quant(self.inner.weight)
+        return F.conv2d(xq, wq, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+def quant_aware(model, weight_bits=8, activation_bits=8, moving_rate=0.9):
+    """Swap every Linear/Conv2D sublayer for its quantized wrapper (the
+    QAT model-rewrite the reference's slim tooling performs)."""
+    for name, sub in list(model._sub_layers.items()):
+        if isinstance(sub, Linear):
+            model._sub_layers[name] = QuantizedLinear(
+                sub, weight_bits, activation_bits, moving_rate)
+        elif isinstance(sub, Conv2D):
+            model._sub_layers[name] = QuantizedConv2D(
+                sub, weight_bits, activation_bits, moving_rate)
+        else:
+            quant_aware(sub, weight_bits, activation_bits, moving_rate)
+    return model

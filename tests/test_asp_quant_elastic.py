@@ -1,0 +1,145 @@
+"""incubate.asp 2:4 sparsity, nn.quant QAT layers, distributed.elastic.
+References: incubate/asp/asp.py, nn/quant/quant_layers.py,
+distributed/elastic.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.incubate import asp
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_asp_prune_and_density():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    masks = asp.prune_model(net)
+    assert len(masks) == 2
+    for p in (net[0].weight, net[2].weight):
+        w = _np(p)
+        assert asp.calculate_density(p) == pytest.approx(0.5)
+        # every group of 4 along the REDUCTION dim (axis 0 for [in, out]
+        # Linear weights) has exactly 2 nonzeros
+        g = (w.T.reshape(w.shape[1], -1, 4) != 0).sum(-1)
+        assert (g == 2).all()
+
+
+def test_asp_training_stays_sparse():
+    paddle.seed(1)
+    net = paddle.nn.Linear(8, 8)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    asp.prune_model(net)
+    opt = asp.decorate(opt)
+    x = Tensor(np.random.RandomState(1).randn(4, 8).astype(np.float32))
+    for _ in range(4):
+        loss = net(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.calculate_density(net.weight) == pytest.approx(0.5)
+    with pytest.raises(TypeError):
+        asp.decorate("nope")
+
+
+def test_asp_conv_reduction_dim():
+    """Conv weights group 2:4 along cin*kh*kw (the contraction), giving
+    exact 0.5 density even when kh*kw is not a multiple of 4."""
+    paddle.seed(5)
+    conv = paddle.nn.Conv2D(4, 8, 3)  # reduction = 4*3*3 = 36, /4 = 9 groups
+    asp.prune_model(conv)
+    w = _np(conv.weight)
+    assert asp.calculate_density(conv.weight) == pytest.approx(0.5)
+    g = (w.reshape(w.shape[0], -1, 4) != 0).sum(-1)
+    assert (g == 2).all()
+
+
+def test_asp_excluded_layers():
+    asp.reset_excluded_layers()
+    paddle.seed(2)
+    net = paddle.nn.Linear(8, 8)
+    asp.set_excluded_layers([net.weight.name])
+    try:
+        masks = asp.prune_model(net)
+        assert not masks
+        assert asp.calculate_density(net.weight) == pytest.approx(1.0)
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_quant_fake_abs_max_and_ste():
+    from paddle_tpu.nn.quant import FakeQuantAbsMax
+
+    q = FakeQuantAbsMax(quant_bits=8)
+    x = Tensor(np.linspace(-1, 1, 9).astype(np.float32), stop_gradient=False)
+    y = q(x)
+    # quant-dequant error bounded by scale/qmax
+    np.testing.assert_allclose(_np(y), _np(x), atol=1.0 / 127 + 1e-6)
+    y.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), 1.0, atol=1e-6)  # STE inside range
+
+
+def test_quantized_linear_trains():
+    from paddle_tpu.nn.quant import quant_aware
+
+    paddle.seed(3)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 1))
+    quant_aware(net)
+    from paddle_tpu.nn.quant import QuantizedLinear
+
+    assert isinstance(net[0], QuantizedLinear)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    rng = np.random.RandomState(3)
+    x = Tensor(rng.randn(16, 8).astype(np.float32))
+    yt = Tensor(rng.randn(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = (net(x) - yt).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(_np(loss)))
+    assert losses[-1] < losses[0]
+    # observer accumulated steps
+    assert float(_np(net[0].act_quant.state)[0]) >= 8
+
+
+def test_elastic_manager(tmp_path):
+    from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+
+    d = str(tmp_path / "el")
+    m0 = ElasticManager(elastic_dir=d, rank=0, world_size=2, timeout=5.0)
+    m1 = ElasticManager(elastic_dir=d, rank=1, world_size=2, timeout=5.0)
+    m0.register()
+    assert m0.watch() == ElasticStatus.HOLD      # peer not yet arrived
+    m1.register()
+    assert m0.watch() is None                    # all healthy -> keep training
+    assert m0.world() == [0, 1]
+    m1.exit(completed=False)
+    assert m0.watch() == ElasticStatus.RESTART   # peer failed
+    m1.heartbeat()
+    m0.exit(completed=True)
+    m1.exit(completed=True)
+    assert m0.watch() == ElasticStatus.COMPLETED
+
+
+def test_elastic_stale_peer(tmp_path):
+    import json
+    import os
+    import time
+
+    from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+
+    d = str(tmp_path / "el2")
+    m0 = ElasticManager(elastic_dir=d, rank=0, world_size=2, timeout=0.2)
+    m0.register()
+    # fake a stale peer heartbeat
+    with open(os.path.join(d, "rank1.json"), "w") as f:
+        json.dump({"rank": 1, "ts": time.time() - 10, "status": "running"}, f)
+    assert m0.watch() == ElasticStatus.RESTART

@@ -7,13 +7,14 @@ from paddle_tpu.framework.tensor import Tensor
 
 
 def test_flops_linear_and_conv():
+    # reference conventions: MAC = 1 op, conv counts bias
     net = paddle.nn.Linear(8, 16)
     n = paddle.flops(net, (4, 8))
-    assert n == 2 * 4 * 8 * 16
+    assert n == 8 * 4 * 16
 
     conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
     n = paddle.flops(conv, (1, 3, 16, 16), print_detail=True)
-    assert n == 2 * 3 * 9 * 8 * 16 * 16
+    assert n == (3 * 9 + 1) * 8 * 16 * 16
 
 
 def test_flops_custom_ops():
