@@ -110,16 +110,20 @@ def _alpha_dropout_masked(x, mask, alpha_p=0.0, a=1.0, b=0.0):
 
 @op("embedding_op")
 def _embedding_raw(weight, ids, padding_idx=None):
+    ids = ids.astype(jnp.int32)
     out = jnp.take(weight, ids, axis=0)
     if padding_idx is not None:
-        mask = (ids != padding_idx)[..., None]
+        # paddle accepts padding_idx in [-vocab, vocab)
+        pi = padding_idx if padding_idx >= 0 else padding_idx + weight.shape[0]
+        mask = (ids != pi)[..., None]
         out = out * mask.astype(out.dtype)
     return out
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    ids = x._value.astype(jnp.int32)
-    return _embedding_raw(weight, Tensor(ids), padding_idx=padding_idx)
+    # the int32 cast happens INSIDE the recorded op so static Variables
+    # stay symbolic (no eager ._value access at record time)
+    return _embedding_raw(weight, x, padding_idx=padding_idx)
 
 
 def one_hot(x, num_classes, name=None):

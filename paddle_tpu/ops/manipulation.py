@@ -367,6 +367,8 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
 
 @op("gather")
 def _gather_raw(x, index, axis=0):
+    if index.ndim > 1:
+        index = index.reshape(-1)
     return jnp.take(x, index, axis=axis)
 
 
@@ -374,10 +376,8 @@ def gather(x, index, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
     index = ensure_tensor(index)
-    idx = index._value
-    if idx.ndim > 1:
-        idx = idx.reshape(-1)
-    return _gather_raw(x, Tensor(idx), axis=int(axis))
+    # index flattening happens inside the op (symbolic-Variable safe)
+    return _gather_raw(x, index, axis=int(axis))
 
 
 @op("gather_nd")
@@ -391,18 +391,18 @@ def gather_nd(x, index, name=None):
 
 
 @op("take_along_axis")
-def _take_along_axis_raw(x, indices, axis=0):
+def _take_along_axis_raw(x, indices, axis=0, broadcast=True):
+    if broadcast:
+        # paddle broadcasts indices against arr except on `axis`
+        tgt = list(x.shape)
+        tgt[axis] = (indices.shape[axis] if indices.ndim == x.ndim
+                     else indices.shape[-1])
+        indices = jnp.broadcast_to(indices, tgt)
     return jnp.take_along_axis(x, indices, axis=axis)
 
 
 def take_along_axis(arr, indices, axis, broadcast=True):
-    idx = indices._value
-    if broadcast:
-        # paddle broadcasts indices against arr except on `axis`
-        tgt = list(arr.shape)
-        tgt[axis] = idx.shape[axis] if idx.ndim == arr.ndim else idx.shape[-1]
-        idx = jnp.broadcast_to(idx, tgt)
-    return _take_along_axis_raw(arr, Tensor(idx), axis=axis)
+    return _take_along_axis_raw(arr, indices, axis=axis, broadcast=broadcast)
 
 
 @op("put_along_axis")
