@@ -302,6 +302,16 @@ def build_pipelined_gpt(cfg, topology, num_microbatches=1, loss_fn=None,
     pp = topology.mesh.devices.shape[ax]
     if cfg.num_layers % pp:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by pp={pp}")
+    if getattr(cfg, "use_sep", False) and pp > 1:
+        sep_ax = topology.mesh.axis_names.index("sep")
+        if topology.mesh.devices.shape[sep_ax] > 1:
+            # the ring's shard_map cannot nest inside the pp-manual stage
+            # body (sdy forbids re-binding the parent's manual axis)
+            raise ValueError(
+                "pipelined GPT with ring-attention sequence parallelism "
+                "(pp>1 AND sep>1) is not supported: compose dp x mp x sep "
+                "(plain GPTForCausalLM) or dp x mp x pp (pipelined) instead"
+            )
     per = cfg.num_layers // pp
 
     pre = GPTEmbeddings(cfg)

@@ -80,6 +80,18 @@ def _ring_attention_impl(q, k, v, mesh, causal, scale, axis=AXIS_SEP):
         # degenerate ring: plain blockwise attention
         return _single_chunk(q, k, v, causal, scale)
 
+    # nested manual regions (e.g. ring attention inside the pp-manual
+    # pipeline stage body): shard_map must receive the AMBIENT abstract mesh
+    # (with the outer axes already marked Manual), not the concrete one
+    try:
+        ambient = jax.sharding.get_abstract_mesh()
+        if ambient is not None and axis in getattr(ambient, "axis_names", ()):
+            if any("Manual" in str(t) for t in
+                   getattr(ambient, "axis_types", ())):
+                mesh = ambient
+    except Exception:
+        pass
+
     def local_fn(q_l, k_l, v_l):
         i = lax.axis_index(axis)
         s_local = q_l.shape[1]

@@ -145,3 +145,17 @@ def test_gpt_sep_jitted_train_step():
     for _ in range(4):
         l1 = float(np.asarray(cs(ids, ids)._value))
     assert np.isfinite(l1) and l1 < l0
+
+
+def test_pp_with_sep_raises_clearly():
+    """Ring attention cannot nest inside the pp-manual pipeline stage (sdy
+    forbids re-binding the parent's manual axis) — must fail loudly."""
+    from paddle_tpu.distributed.meta_parallel import build_pipelined_gpt
+    from paddle_tpu.models import GPTConfig
+
+    hcg = _init_fleet(dp=1, pp=2, sep=2)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                    max_position_embeddings=32, hidden_dropout=0.0,
+                    attention_dropout=0.0, use_sep=True)
+    with pytest.raises(ValueError, match="pp>1 AND sep>1"):
+        build_pipelined_gpt(cfg, hcg, num_microbatches=2)

@@ -1,0 +1,84 @@
+"""Long-context training demonstration on one chip.
+
+Trains the flagship GPT at growing sequence lengths with the Pallas flash
+kernel (1024x1024 tiles): attention memory stays O(s·d) so sequence length
+scales until the weights/activations bound, not the s² score matrix. The
+multi-chip extension is ring attention over the `sep` axis
+(distributed/meta_parallel/sequence_parallel.py), dryrun-validated on the
+virtual mesh; this tool shows the single-chip long-seq numbers the ring
+composes from.
+
+Run: python tools/long_context_bench.py [--seqs 2048,4096,8192]
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seqs", default="2048,4096,8192")
+    ap.add_argument("--tokens-per-batch", type=int, default=16384)
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    on_tpu = jax.default_backend() != "cpu"
+    for seq in [int(s) for s in args.seqs.split(",")]:
+        batch = max(1, args.tokens_per_batch // seq)
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=seq,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+        if on_tpu:
+            model.to(dtype="bfloat16")
+            for name, sub in model.named_sublayers():
+                if type(sub).__name__ == "LayerNorm":
+                    sub.to(dtype="float32")
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters(),
+                                     multi_precision=on_tpu)
+
+        def train_step(ids, labels):
+            loss = model.loss(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step = CompiledStep(train_step, stateful=[model, opt],
+                            donate_state=True)
+        rng = np.random.RandomState(time.time_ns() % (2**31))
+        n = 6
+        batches = [Tensor(rng.randint(0, cfg.vocab_size,
+                                      (batch, seq)).astype(np.int64))
+                   for _ in range(2 + n)]
+        for i in range(2):
+            np.asarray(step(batches[i], batches[i])._value)
+        t0 = time.perf_counter()
+        outs = [step(b, b) for b in batches[2:]]
+        last = float(np.asarray(outs[-1]._value))
+        dt = (time.perf_counter() - t0) / n
+        toks = batch * seq / dt
+        # attention share grows with s: flops/token = 6*N_mat + 12*L*H*s
+        n_mat = cfg.num_layers * 12 * cfg.hidden_size ** 2 \
+            + cfg.vocab_size * cfg.hidden_size
+        fpt = 6 * n_mat + 12 * cfg.num_layers * cfg.hidden_size * seq
+        mfu = toks * fpt / 197e12 if on_tpu else float("nan")
+        assert np.isfinite(last)
+        print(f"seq={seq:6d} batch={batch:3d}: {dt * 1e3:8.1f} ms/step "
+              f"{toks:9.0f} tok/s  mfu={mfu:.3f}  loss={last:.3f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
