@@ -85,6 +85,8 @@ from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import incubate  # noqa: F401
 from . import utils  # noqa: F401
+from . import device  # noqa: F401
+from . import cost_model  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.model_summary import summary, flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401
@@ -137,3 +139,13 @@ def set_printoptions(**kwargs):
     import numpy as np
 
     np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})
+
+
+def __getattr__(name):
+    # paddle.distributed is imported lazily: it builds mesh/topology state on
+    # import, which not every single-chip program needs at startup
+    if name == "distributed":
+        import importlib
+
+        return importlib.import_module(".distributed", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

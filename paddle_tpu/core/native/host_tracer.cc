@@ -1,0 +1,99 @@
+// Host-side event tracer: the native RecordEvent sink.
+//
+// Reference analogue: paddle/fluid/platform/profiler/host_tracer.cc +
+// chrometracing_logger.cc — RecordEvent annotations throughout the host hot
+// paths append to a per-thread buffer with nanosecond clocks, later merged
+// and exported as chrome trace.
+//
+// TPU-native role: python-side RecordEvent (paddle_tpu/profiler) calls here
+// via ctypes so the common record path costs a clock read + an append into a
+// preallocated slab instead of python object churn; the device timeline
+// comes from jax's XPlane profiler and the two are merged at export.
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  int64_t start_ns;
+  int64_t end_ns;
+  uint64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<Event> g_events;
+bool g_enabled = false;
+size_t g_capacity = 0;
+
+int64_t now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+int pt_tracer_start(long long capacity) {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_events.clear();
+  g_capacity = static_cast<size_t>(capacity);
+  g_events.reserve(g_capacity);
+  g_enabled = true;
+  return 0;
+}
+
+void pt_tracer_stop() {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_enabled = false;
+}
+
+long long pt_tracer_now_ns() { return now_ns(); }
+
+int pt_tracer_record(const char* name, long long start_ns, long long end_ns) {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (!g_enabled || g_events.size() >= g_capacity) return -1;
+  uint64_t tid = std::hash<std::thread::id>{}(std::this_thread::get_id());
+  g_events.push_back(Event{name, start_ns, end_ns, tid});
+  return 0;
+}
+
+long long pt_tracer_count() {
+  std::lock_guard<std::mutex> g(g_mu);
+  return static_cast<long long>(g_events.size());
+}
+
+// Serialize all events as lines "name\tstart\tend\ttid\n" into buf.
+// Returns bytes written, or -needed when buflen is too small.
+long long pt_tracer_dump(char* buf, long long buflen) {
+  std::lock_guard<std::mutex> g(g_mu);
+  std::string out;
+  for (const auto& e : g_events) {
+    out += e.name;
+    out += '\t';
+    out += std::to_string(e.start_ns);
+    out += '\t';
+    out += std::to_string(e.end_ns);
+    out += '\t';
+    out += std::to_string(e.tid);
+    out += '\n';
+  }
+  if (static_cast<long long>(out.size()) > buflen)
+    return -static_cast<long long>(out.size());
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<long long>(out.size());
+}
+
+void pt_tracer_clear() {
+  std::lock_guard<std::mutex> g(g_mu);
+  g_events.clear();
+}
+
+}  // extern "C"

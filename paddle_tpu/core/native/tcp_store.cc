@@ -1,0 +1,375 @@
+// TCPStore: socket KV store for multi-process rendezvous and barriers.
+//
+// Reference analogue: paddle/fluid/distributed/store/tcp_store.cc +
+// tcp_utils.cc (the bootstrap KV store behind init_parallel_env; rank 0
+// hosts, every rank connects, keys carry endpoint/uniqueid payloads and
+// atomic counters implement barriers).
+//
+// TPU-native role: jax's distributed runtime brings its own coordination
+// service for device initialization, but the framework still needs a
+// general-purpose host-side store for the launch CLI (electing the
+// coordinator, publishing per-rank endpoints, exit barriers) and for
+// user-level Store APIs. This is a from-scratch implementation: a
+// single-threaded-per-connection blocking server over a mutex-protected
+// map with a condition variable for waiters.
+//
+// Wire protocol (little-endian):
+//   request : op(u8) keylen(u32) key [payload]
+//     SET  (1): payload = vallen(u32) value            -> status(u8)
+//     GET  (2): payload = timeout_ms(i32)              -> status(u8) [vallen(u32) value]
+//     ADD  (3): payload = delta(i64)                   -> status(u8) newval(i64)
+//     WAIT (4): payload = timeout_ms(i32)              -> status(u8)
+//     PING (5): payload = none                         -> status(u8)
+//   status: 0 = ok, 1 = timeout
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kPing = 5 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    if (::listen(listen_fd_, 128) != 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return false;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stopping_.store(true);
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    cv_.notify_all();
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::vector<std::thread> workers;
+    {
+      std::lock_guard<std::mutex> g(workers_mu_);
+      workers.swap(workers_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+      client_fds_.clear();
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+  ~StoreServer() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(workers_mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stopping_.load()) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!read_full(fd, &klen, 4) || klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!read_full(fd, key.data(), klen)) break;
+
+      if (op == kSet) {
+        uint32_t vlen;
+        if (!read_full(fd, &vlen, 4) || vlen > (1u << 28)) break;
+        std::string val(vlen, '\0');
+        if (!read_full(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          data_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        uint8_t st = 0;
+        if (!write_full(fd, &st, 1)) break;
+      } else if (op == kGet || op == kWait) {
+        int32_t timeout_ms;
+        if (!read_full(fd, &timeout_ms, 4)) break;
+        std::unique_lock<std::mutex> lk(mu_);
+        bool ok = cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+          return stopping_.load() || data_.count(key) > 0;
+        });
+        ok = ok && data_.count(key) > 0;
+        if (op == kWait) {
+          lk.unlock();
+          uint8_t st = ok ? 0 : 1;
+          if (!write_full(fd, &st, 1)) break;
+        } else {
+          std::string val = ok ? data_[key] : std::string();
+          lk.unlock();
+          uint8_t st = ok ? 0 : 1;
+          if (!write_full(fd, &st, 1)) break;
+          if (ok) {
+            uint32_t vlen = static_cast<uint32_t>(val.size());
+            if (!write_full(fd, &vlen, 4)) break;
+            if (vlen && !write_full(fd, val.data(), vlen)) break;
+          }
+        }
+      } else if (op == kAdd) {
+        int64_t delta;
+        if (!read_full(fd, &delta, 8)) break;
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          auto it = data_.find(key);
+          if (it != data_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string v(8, '\0');
+          std::memcpy(v.data(), &cur, 8);
+          data_[key] = std::move(v);
+        }
+        cv_.notify_all();
+        uint8_t st = 0;
+        if (!write_full(fd, &st, 1) || !write_full(fd, &cur, 8)) break;
+      } else if (op == kPing) {
+        uint8_t st = 0;
+        if (!write_full(fd, &st, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string port_s = std::to_string(port);
+    if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res)
+      return false;
+    // retry until the server comes up or the deadline passes (ranks race
+    // with rank0's bind — the reference client retries the same way)
+    while (std::chrono::steady_clock::now() < deadline) {
+      fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd_ >= 0 &&
+          ::connect(fd_, res->ai_addr, res->ai_addrlen) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        ::freeaddrinfo(res);
+        return true;
+      }
+      if (fd_ >= 0) ::close(fd_);
+      fd_ = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    ::freeaddrinfo(res);
+    return false;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  int Set(const char* key, const uint8_t* val, int len) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = kSet;
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    uint32_t vlen = static_cast<uint32_t>(len);
+    if (!(write_full(fd_, &op, 1) && write_full(fd_, &klen, 4) &&
+          write_full(fd_, key, klen) && write_full(fd_, &vlen, 4) &&
+          (len == 0 || write_full(fd_, val, vlen))))
+      return -1;
+    uint8_t st;
+    return read_full(fd_, &st, 1) && st == 0 ? 0 : -1;
+  }
+
+  // returns value length, -1 on timeout, -2 on connection error,
+  // -3 - needed_len when buf is too small (value is consumed)
+  int Get(const char* key, uint8_t* buf, int buflen, int timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = kGet;
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    int32_t to = timeout_ms;
+    if (!(write_full(fd_, &op, 1) && write_full(fd_, &klen, 4) &&
+          write_full(fd_, key, klen) && write_full(fd_, &to, 4)))
+      return -2;
+    uint8_t st;
+    if (!read_full(fd_, &st, 1)) return -2;
+    if (st != 0) return -1;
+    uint32_t vlen;
+    if (!read_full(fd_, &vlen, 4)) return -2;
+    std::string tmp(vlen, '\0');
+    if (vlen && !read_full(fd_, tmp.data(), vlen)) return -2;
+    if (static_cast<int>(vlen) > buflen) return -3 - static_cast<int>(vlen);
+    std::memcpy(buf, tmp.data(), vlen);
+    return static_cast<int>(vlen);
+  }
+
+  long long Add(const char* key, long long delta, int* status) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = kAdd;
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    int64_t d = delta;
+    *status = -1;
+    if (!(write_full(fd_, &op, 1) && write_full(fd_, &klen, 4) &&
+          write_full(fd_, key, klen) && write_full(fd_, &d, 8)))
+      return 0;
+    uint8_t st;
+    int64_t out;
+    if (!read_full(fd_, &st, 1) || st != 0 || !read_full(fd_, &out, 8))
+      return 0;
+    *status = 0;
+    return out;
+  }
+
+  int Wait(const char* key, int timeout_ms) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint8_t op = kWait;
+    uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+    int32_t to = timeout_ms;
+    if (!(write_full(fd_, &op, 1) && write_full(fd_, &klen, 4) &&
+          write_full(fd_, key, klen) && write_full(fd_, &to, 4)))
+      return -2;
+    uint8_t st;
+    if (!read_full(fd_, &st, 1)) return -2;
+    return st == 0 ? 0 : -1;
+  }
+
+ private:
+  int fd_ = -1;
+  std::mutex mu_;  // one request in flight per client handle
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_tcpstore_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pt_tcpstore_server_port(void* h) {
+  return static_cast<StoreServer*>(h)->port();
+}
+
+void pt_tcpstore_server_stop(void* h) { delete static_cast<StoreServer*>(h); }
+
+void* pt_tcpstore_connect(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->Connect(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pt_tcpstore_close(void* h) { delete static_cast<StoreClient*>(h); }
+
+int pt_tcpstore_set(void* h, const char* key, const uint8_t* val, int len) {
+  return static_cast<StoreClient*>(h)->Set(key, val, len);
+}
+
+int pt_tcpstore_get(void* h, const char* key, uint8_t* buf, int buflen,
+                    int timeout_ms) {
+  return static_cast<StoreClient*>(h)->Get(key, buf, buflen, timeout_ms);
+}
+
+long long pt_tcpstore_add(void* h, const char* key, long long delta,
+                          int* status) {
+  return static_cast<StoreClient*>(h)->Add(key, delta, status);
+}
+
+int pt_tcpstore_wait(void* h, const char* key, int timeout_ms) {
+  return static_cast<StoreClient*>(h)->Wait(key, timeout_ms);
+}
+
+}  // extern "C"

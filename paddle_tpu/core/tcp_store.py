@@ -1,0 +1,116 @@
+"""TCPStore python surface over the native store (reference
+``core.TCPStore`` bound in ``pybind/distributed_py.cc``; used by
+``distributed/parallel.py:240-245`` for rendezvous).
+
+API parity: ``TCPStore(host, port, is_master, world_size, timeout)`` with
+``set/get/add/wait``; plus ``barrier`` (the reference builds barriers from
+add+wait in python — here it's one call).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+
+class TCPStoreError(RuntimeError):
+    pass
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=900):
+        from . import load_native, native_load_error
+
+        lib = load_native()
+        if lib is None:
+            raise TCPStoreError(
+                f"native core library unavailable: {native_load_error()!r}")
+        self._lib = lib
+        self._server = None
+        self._client = None
+        self.world_size = int(world_size)
+        self.timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = lib.pt_tcpstore_server_start(int(port))
+            if not self._server:
+                raise TCPStoreError(f"cannot bind TCPStore server on port {port}")
+            port = lib.pt_tcpstore_server_port(self._server)
+        self.host = host
+        self.port = int(port)
+        self._client = lib.pt_tcpstore_connect(
+            host.encode(), self.port, self.timeout_ms)
+        if not self._client:
+            self.close()
+            raise TCPStoreError(
+                f"cannot connect to TCPStore at {host}:{self.port}")
+
+    # -- KV API -------------------------------------------------------------
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_tcpstore_set(
+            self._client, key.encode(), bytes(value), len(value))
+        if rc != 0:
+            raise TCPStoreError(f"set({key!r}) failed")
+
+    def get(self, key, timeout=None):
+        to = self.timeout_ms if timeout is None else int(timeout * 1000)
+        buflen = 1 << 16
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(buflen)
+            rc = self._lib.pt_tcpstore_get(
+                self._client, key.encode(), buf, buflen, to)
+            if rc >= 0:
+                return buf.raw[:rc]
+            if rc == -1:
+                raise TCPStoreError(f"get({key!r}): timeout after {to} ms")
+            if rc <= -3:
+                buflen = -rc - 3 + 16
+                continue
+            raise TCPStoreError(f"get({key!r}): connection error")
+        raise TCPStoreError(f"get({key!r}): value too large")
+
+    def add(self, key, amount=1):
+        st = ctypes.c_int(0)
+        out = self._lib.pt_tcpstore_add(
+            self._client, key.encode(), int(amount), ctypes.byref(st))
+        if st.value != 0:
+            raise TCPStoreError(f"add({key!r}) failed")
+        return int(out)
+
+    def wait(self, keys, timeout=None):
+        to = self.timeout_ms if timeout is None else int(timeout * 1000)
+        if isinstance(keys, (str, bytes)):
+            keys = [keys]
+        for k in keys:
+            k = k.decode() if isinstance(k, bytes) else k
+            rc = self._lib.pt_tcpstore_wait(self._client, k.encode(), to)
+            if rc == -1:
+                raise TCPStoreError(f"wait({k!r}): timeout after {to} ms")
+            if rc != 0:
+                raise TCPStoreError(f"wait({k!r}): connection error")
+
+    def barrier(self, name="barrier", world_size=None, timeout=None):
+        """All ranks arrive (add) then wait for the release key the last
+        rank publishes."""
+        n = int(world_size or self.world_size)
+        arrived = self.add(f"__barrier/{name}/count", 1)
+        if arrived % n == 0:
+            self.set(f"__barrier/{name}/release{arrived // n}", b"1")
+        gen = (arrived + n - 1) // n
+        self.wait([f"__barrier/{name}/release{gen}"], timeout)
+
+    def close(self):
+        if self._client:
+            self._lib.pt_tcpstore_close(self._client)
+            self._client = None
+        if self._server:
+            self._lib.pt_tcpstore_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,169 @@
+"""paddle.device (reference ``python/paddle/device/``: device selection,
+cuda streams/events, synchronization).
+
+TPU-native: device selection maps onto jax's device list (``set_device``
+lives in framework.place); streams are owned by the XLA runtime — the
+Stream/Event surface is preserved for API parity and expressed through
+jax's async dispatch (an Event records a marker array; synchronize blocks
+on it). ``paddle.device.cuda`` aliases the accelerator namespace the way
+the reference's code expects to call it.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    set_device,
+)
+
+__all__ = [
+    "set_device", "get_device", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device", "synchronize", "device_count",
+    "Stream", "Event", "current_stream", "stream_guard", "cuda",
+]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+def device_count():
+    return jax.local_device_count()
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work is complete (reference
+    ``cudaDeviceSynchronize``); jax: barrier on the async dispatch queue."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class Event:
+    """Reference ``paddle.device.cuda.Event``: record/ query/ synchronize.
+    Records a marker array whose readiness tracks everything dispatched
+    before it (XLA executes a device's work in dispatch order)."""
+
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        self._marker = None
+
+    def record(self, stream=None):
+        self._marker = jax.device_put(0.0) + 0
+
+    def query(self):
+        if self._marker is None:
+            return True
+        return self._marker.is_ready()
+
+    def synchronize(self):
+        if self._marker is not None:
+            self._marker.block_until_ready()
+
+
+class Stream:
+    """API-parity stream: XLA owns real stream scheduling; wait/record are
+    expressed as dispatch-order barriers."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def query(self):
+        return True
+
+
+_default_stream = Stream()
+
+
+def current_stream(device=None):
+    return _default_stream
+
+
+class stream_guard:
+    """Context shim (reference ``paddle.device.cuda.stream_guard``)."""
+
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _CudaNamespace:
+    """``paddle.device.cuda`` — accelerator namespace alias."""
+
+    Event = Event
+    Stream = Stream
+    stream_guard = staticmethod(stream_guard)
+    current_stream = staticmethod(current_stream)
+    synchronize = staticmethod(synchronize)
+    device_count = staticmethod(device_count)
+
+    @staticmethod
+    def empty_cache():
+        # XLA's BFC allocator manages HBM; jax exposes explicit donation
+        # instead of a cache purge. Kept for API parity.
+        return None
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = _mem_stats()
+        return int(stats.get("peak_bytes_in_use", 0))
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = _mem_stats()
+        return int(stats.get("bytes_in_use", 0))
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.devices()[0]
+        return {"name": d.device_kind, "platform": d.platform, "id": d.id}
+
+
+def _mem_stats():
+    try:
+        return jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+cuda = _CudaNamespace()

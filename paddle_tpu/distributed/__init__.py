@@ -42,6 +42,8 @@ from .parallel import (  # noqa: F401
     init_parallel_env,
 )
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from ..core.tcp_store import TCPStore  # noqa: F401  (native rendezvous store)
+from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import sharding  # noqa: F401

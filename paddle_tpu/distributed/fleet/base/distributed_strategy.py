@@ -74,7 +74,10 @@ class DistributedStrategy:
         self.nccl_comm_num = 1
         self.sync_nccl_allreduce = False
         self.localsgd = False
+        self.localsgd_configs = _ConfigDict(k_steps=1, begin_step=1)
         self.dgc = False
+        self.dgc_configs = _ConfigDict(
+            rampup_begin_step=0, rampup_step=1, sparsity=[0.999])
         self.lars = False
         self.lamb = False
         self.asp = False

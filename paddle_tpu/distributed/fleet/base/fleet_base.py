@@ -111,13 +111,51 @@ class Fleet:
         return TensorParallel(model, self._hcg, self._strategy)
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        """reference fleet_base.py:890 → HybridParallelOptimizer glue."""
+        """reference fleet_base.py:890 → meta-optimizer chain (the
+        strategy_compiler role): dgc replaces the inner momentum; gradient
+        merge then localsgd wrap around; HybridParallelOptimizer is the
+        outermost glue."""
         if strategy is not None:
             self._strategy = strategy
         if self._hcg is None:
             self.init()
+        strat = self._strategy
+        from ..meta_optimizers import (
+            DGCMomentumOptimizer,
+            GradientMergeOptimizer,
+            LocalSGDOptimizer,
+        )
         from ...meta_parallel.hybrid_optimizer import HybridParallelOptimizer
 
+        if strat.dgc:
+            from ....optimizer import Momentum
+
+            if isinstance(optimizer, Momentum):
+                cfg = strat.dgc_configs
+                optimizer = DGCMomentumOptimizer(
+                    learning_rate=optimizer._learning_rate,
+                    momentum=optimizer._momentum,
+                    rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                    rampup_step=cfg.get("rampup_step", 1),
+                    sparsity=cfg.get("sparsity", [0.999]),
+                    parameters=optimizer._parameter_list,
+                    use_nesterov=optimizer._use_nesterov,
+                    weight_decay=optimizer._weight_decay,
+                    grad_clip=optimizer._grad_clip,
+                    multi_precision=optimizer._multi_precision,
+                    group=self._hcg.get_data_parallel_group(),
+                )
+        if strat.gradient_merge:
+            cfg = strat.gradient_merge_configs
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                avg=cfg.get("avg", True))
+        if strat.localsgd:
+            cfg = strat.localsgd_configs
+            optimizer = LocalSGDOptimizer(
+                optimizer, k_steps=cfg.get("k_steps", 1),
+                begin_step=cfg.get("begin_step", 1),
+                group=self._hcg.get_data_parallel_group())
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
 
     # -- state io ------------------------------------------------------------

@@ -1,0 +1,81 @@
+"""paddle.distributed.spawn (reference ``python/paddle/distributed/spawn.py``
+— fork/spawn N worker processes running ``func(*args)`` with the parallel
+env prepared, used as the in-script alternative to the launch CLI).
+
+TPU-native: on a real pod each host is one jax process, so ``nprocs``
+defaults to 1 there; multi-process spawn is the CPU-backend parity path
+(gloo-style testing) and sets the same PADDLE_*/distributed env surface the
+launch CLI uses, with a jax.distributed coordinator on a local port.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+import traceback
+
+__all__ = ["spawn"]
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args, rank, nprocs, coord, backend, err_q):
+    try:
+        os.environ["PADDLE_TRAINER_ID"] = str(rank)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+        os.environ["PADDLE_MASTER"] = coord
+        os.environ["PADDLE_RANK_IN_NODE"] = str(rank)
+        os.environ["PADDLE_DISTRI_BACKEND"] = backend or ""
+        if backend == "gloo":
+            # CPU multi-controller testing: each worker is its own jax process
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            os.environ["PADDLE_COORDINATOR"] = coord
+        func(*args)
+    except Exception:  # noqa: BLE001 - ship the traceback to the parent
+        err_q.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
+          **options):
+    """Run ``func(*args)`` in ``nprocs`` fresh processes.
+
+    Returns the context (list of processes) when ``join=False``; raises the
+    first worker traceback otherwise.
+    """
+    if nprocs <= 1 and join:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    coord = options.get("master", f"127.0.0.1:{_free_port()}")
+    err_q = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(
+            target=_worker,
+            args=(func, args, rank, nprocs, coord, backend, err_q),
+            daemon=daemon,
+        )
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    fails = [p for p in procs if p.exitcode != 0]
+    if fails:
+        msg = ""
+        try:
+            while True:
+                rank, tb = err_q.get_nowait()
+                msg += f"\n----- rank {rank} -----\n{tb}"
+        except Exception:
+            pass
+        raise RuntimeError(
+            f"{len(fails)}/{nprocs} spawned workers failed{msg or ' (no traceback captured)'}"
+        )
+    return None

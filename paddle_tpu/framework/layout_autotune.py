@@ -1,0 +1,24 @@
+"""Layout autotune (reference ``imperative/layout_autotune.cc``: globally
+rewrite conv-family ops from NCHW to NHWC when the device prefers
+channels-last, inserting transposes at graph boundaries).
+
+TPU-native: the TPU convolution units natively consume NHWC; when enabled,
+NCHW convs execute as transpose→NHWC-conv→transpose. XLA's layout
+assignment usually folds the interior transposes of back-to-back convs
+away, which is exactly the reference's "heavily-layout-sensitive ops carry
+the tuned layout" behavior without a per-op layout state machine.
+Enable via ``paddle.incubate.autotune.set_config({"layout": {"enable":
+True}})``.
+"""
+from __future__ import annotations
+
+_enabled = False
+
+
+def enable_layout_autotune(flag=True):
+    global _enabled
+    _enabled = bool(flag)
+
+
+def layout_autotune_enabled():
+    return _enabled

@@ -5,4 +5,6 @@ from . import optimizer  # noqa: F401
 from . import nn  # noqa: F401
 from . import autotune  # noqa: F401
 from . import asp  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import multiprocessing  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

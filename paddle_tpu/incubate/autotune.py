@@ -20,8 +20,15 @@ _STATUS = {"kernel": {"enable": True}, "layout": {"enable": False},
 def set_config(config=None):
     """Accepts the reference's dict or a JSON file path."""
     if config is None:
+        # reference semantics: config=None resets EVERY autotune section to
+        # its default, not just the kernel one
+        from ..framework.layout_autotune import enable_layout_autotune
+
         _STATUS["kernel"]["enable"] = True
+        _STATUS["layout"]["enable"] = False
+        _STATUS["dataloader"]["enable"] = False
         set_flags({"disable_flash_attention": False})
+        enable_layout_autotune(False)
         return
     if isinstance(config, str):
         with open(config) as f:
@@ -38,6 +45,10 @@ def set_config(config=None):
         set_flags({"disable_flash_attention": True})
     elif "kernel" in config:
         set_flags({"disable_flash_attention": False})
+    if "layout" in config:
+        from ..framework.layout_autotune import enable_layout_autotune
+
+        enable_layout_autotune(bool(_STATUS["layout"].get("enable")))
 
 
 def get_status():

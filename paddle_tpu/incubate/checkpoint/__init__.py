@@ -1,0 +1,2 @@
+"""paddle.incubate.checkpoint (reference ``fluid/incubate/checkpoint/``)."""
+from . import auto_checkpoint  # noqa: F401

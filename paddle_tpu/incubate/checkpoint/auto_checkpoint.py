@@ -1,0 +1,93 @@
+"""Auto-checkpoint for failure resume (reference
+``fluid/incubate/checkpoint/auto_checkpoint.py:71`` AutoCheckpointChecker —
+an epoch-range hook that snapshots training state and, after a restart,
+fast-forwards the epoch loop to the last saved epoch).
+
+TPU-native redesign: the reference serializes ProgramDesc + persistables to
+HDFS; here the state is the registered Layers'/Optimizers' state_dicts
+saved with the framework's own checkpoint format to a local/NFS dir. The
+user-facing contract is identical::
+
+    acp.register(model=model, optimizer=opt)
+    for epoch in acp.train_epoch_range(10, save_dir="ckpt"):
+        train_one_epoch()
+
+On a fresh run epochs 0..9 execute; if the job dies after epoch 3, the
+rerun resumes at epoch 4 with restored state.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+__all__ = ["register", "train_epoch_range", "reset"]
+
+_registered = {"layers": [], "optimizers": []}
+
+
+def register(model=None, optimizer=None, **named):
+    """Register the stateful objects whose state the checkpointer owns."""
+    from ...nn.layer.layers import Layer
+    from ...optimizer.optimizer import Optimizer
+
+    objs = [model, optimizer] + list(named.values())
+    for o in objs:
+        if o is None:
+            continue
+        if isinstance(o, Layer):
+            _registered["layers"].append(o)
+        elif isinstance(o, Optimizer) or hasattr(o, "state_dict"):
+            _registered["optimizers"].append(o)
+        else:
+            raise TypeError(f"cannot checkpoint object of type {type(o)!r}")
+
+
+def reset():
+    _registered["layers"].clear()
+    _registered["optimizers"].clear()
+
+
+def _marker_path(save_dir):
+    return os.path.join(save_dir, "acp_meta.json")
+
+
+def _save(save_dir, epoch):
+    from ...framework.io import save as psave
+
+    os.makedirs(save_dir, exist_ok=True)
+    for i, l in enumerate(_registered["layers"]):
+        psave(l.state_dict(), os.path.join(save_dir, f"layer{i}.pdparams"))
+    for i, o in enumerate(_registered["optimizers"]):
+        psave(o.state_dict(), os.path.join(save_dir, f"opt{i}.pdopt"))
+    # write the marker last and atomically: a crash mid-save must leave the
+    # previous epoch resumable (the reference's checkpoint epoch ordering)
+    fd, tmp = tempfile.mkstemp(dir=save_dir)
+    with os.fdopen(fd, "w") as f:
+        json.dump({"epoch": epoch}, f)
+    os.replace(tmp, _marker_path(save_dir))
+
+
+def _restore(save_dir):
+    from ...framework.io import load as pload
+
+    marker = _marker_path(save_dir)
+    if not os.path.exists(marker):
+        return -1
+    with open(marker) as f:
+        epoch = json.load(f)["epoch"]
+    for i, l in enumerate(_registered["layers"]):
+        l.set_state_dict(pload(os.path.join(save_dir, f"layer{i}.pdparams")))
+    for i, o in enumerate(_registered["optimizers"]):
+        o.set_state_dict(pload(os.path.join(save_dir, f"opt{i}.pdopt")))
+    return epoch
+
+
+def train_epoch_range(max_epoch_num, save_dir="auto_checkpoint",
+                      save_checkpoint_inter=1):
+    """Generator over epochs with restore-on-entry and save-per-epoch."""
+    last = _restore(save_dir)
+    for epoch in range(last + 1, max_epoch_num):
+        yield epoch
+        if (epoch + 1) % save_checkpoint_inter == 0 or epoch == max_epoch_num - 1:
+            _save(save_dir, epoch)

@@ -1,0 +1,48 @@
+"""paddle.incubate.multiprocessing (reference
+``python/paddle/incubate/multiprocessing/__init__.py`` + ``reductions.py``:
+makes Tensors picklable across processes via shared-memory file descriptors
+so DataLoader workers / spawn targets can pass tensors).
+
+TPU-native: device arrays cannot share HBM across host processes; the
+portable cross-process representation is host numpy. The reduction
+registered here pickles a Tensor as (numpy bytes, dtype, stop_gradient) —
+correctness-preserving, one host copy, matching how the framework's own
+DataLoader workers already move data. API parity: this module re-exports
+the stdlib multiprocessing surface after installing the reducers.
+"""
+from __future__ import annotations
+
+import copyreg
+from multiprocessing import *  # noqa: F401,F403 - reference re-exports mp
+from multiprocessing import get_context, Process, Queue  # noqa: F401
+
+import numpy as np
+
+
+def _rebuild_tensor(arr, stop_gradient):
+    from ..framework.tensor import Tensor
+
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(t):
+    return _rebuild_tensor, (np.asarray(t._value), bool(t.stop_gradient))
+
+
+_installed = False
+
+
+def _install_reductions():
+    global _installed
+    if _installed:
+        return
+    from ..framework.tensor import Parameter, Tensor
+
+    copyreg.pickle(Tensor, _reduce_tensor)
+    copyreg.pickle(Parameter, _reduce_tensor)
+    _installed = True
+
+
+_install_reductions()

@@ -1,4 +1,5 @@
 """paddle.jit equivalent — compiled-step cache instead of ProgramDesc executor."""
+from . import dy2static  # noqa: F401
 from .functionalize import (  # noqa: F401
     CompiledStep,
     StaticFunction,
@@ -10,7 +11,9 @@ from .save_load import InputSpec, TranslatedLayer, load, save  # noqa: F401
 
 
 def enable_to_static(flag=True):
-    pass
+    """Toggle the dy2static AST conversion globally (reference
+    ``paddle.jit.enable_to_static``)."""
+    dy2static.enable(flag)
 
 
 class ProgramTranslator:
@@ -25,4 +28,4 @@ class ProgramTranslator:
         return cls._instance
 
     def enable(self, flag=True):
-        pass
+        dy2static.enable(flag)

@@ -1,0 +1,665 @@
+"""Dygraph-to-static AST transformation: Python control flow → lax control flow.
+
+Reference analogue: ``python/paddle/fluid/dygraph/dygraph_to_static/``
+(``program_translator.py:991`` ProgramTranslator + ``ifelse_transformer.py``,
+``loop_transformer.py``, ``logical_transformer.py``).  The reference rewrites
+Python source so that tensor-dependent ``if``/``while``/``for`` become
+``conditional_block``/``while`` ops in a ProgramDesc.
+
+TPU-native redesign: the rewrite targets are the framework's dual-mode
+control-flow primitives (:func:`paddle_tpu.static.nn.cond` /
+:func:`~paddle_tpu.static.nn.while_loop`), which python-branch eagerly and
+lower to ``lax.cond`` / ``lax.while_loop`` under a jit trace or static
+Program recording.  Because those primitives already thread autograd through
+``apply_op``, transformed control flow is differentiable in both modes —
+there is no separate "static backward" pass to generate.
+
+Mechanics (same shape as the reference's transformers):
+
+- a tensor-dependent ``if`` becomes a pair of zero-arg branch closures over
+  the enclosing frame plus ``get/set`` state accessors for every name the
+  branches assign (``nonlocal``-threading, the reference's
+  ``create_get_args_node``/``create_set_args_node`` pattern);
+- ``while``/``for range`` become loop-body closures with the assigned names
+  as loop-carried state;
+- ``and``/``or``/``not`` become lazy converters that preserve Python
+  short-circuit semantics when the operands are concrete.
+
+Deliberate contract differences from the reference (documented, checked):
+
+- ``return``/``break``/``continue`` inside a *tensor-dependent* block are
+  not restructured; such statements leave the enclosing construct in plain
+  Python form (correct eagerly, clear jax ConcretizationTypeError under
+  trace).  The reference's ReturnTransformer covers these; here the
+  functional jax style makes early-exit rewrites a poor trade.
+- a name assigned under a tensor-dependent ``if`` must either exist before
+  the ``if`` or be assigned in **both** branches (the reference raises the
+  same class of error at ProgramDesc build time for undefined vars).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while", "convert_for_range"]
+
+
+class _Undefined:
+    """Sentinel for names not yet bound in the enclosing frame (reference
+    ``dygraph_to_static/utils.py`` UndefinedVar)."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+_enabled = True
+
+
+def enable(flag=True):
+    global _enabled
+    _enabled = bool(flag)
+
+
+def _tensor_mod():
+    from ..framework import tensor as T
+
+    return T
+
+
+def _concrete_bool(v):
+    """Python bool of v if it is concrete, else None (symbolic)."""
+    from ..static.program import Variable
+    T = _tensor_mod()
+
+    if isinstance(v, Variable):
+        return None
+    if isinstance(v, T.Tensor):
+        v = v._value
+    if T._is_tracer(v):
+        return None
+    if isinstance(v, jax.Array):
+        return bool(v)
+    return bool(v)
+
+
+def _is_arraylike(v):
+    from ..static.program import Variable
+    T = _tensor_mod()
+
+    return isinstance(
+        v, (T.Tensor, Variable, jax.Array, np.ndarray, int, float, bool, np.generic)
+    ) or T._is_tracer(v)
+
+
+def _as_tensor(v):
+    from ..static.program import Variable
+    T = _tensor_mod()
+
+    if isinstance(v, (T.Tensor, Variable)):
+        return v
+    return T.Tensor(jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (the reference's convert_operators.py)
+# ---------------------------------------------------------------------------
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_state, set_state, names):
+    """Runtime dispatch for a transformed ``if`` (reference
+    ``convert_operators.py convert_ifelse``)."""
+    t = _concrete_bool(pred)
+    if t is not None:
+        (true_fn if t else false_fn)()
+        return
+
+    from ..static import nn as snn
+
+    init = list(get_state())
+    thread = [i for i, v in enumerate(init) if _is_arraylike(v)]
+    operands = [_as_tensor(init[i]) for i in thread]
+
+    def _branch(fn, tag):
+        def run(*vals):
+            cur = list(init)
+            for pos, v in zip(thread, vals):
+                cur[pos] = v
+            set_state(tuple(cur))
+            fn()
+            out = list(get_state())
+            for name, v in zip(names, out):
+                if v is UNDEF:
+                    raise ValueError(
+                        f"dy2static: variable {name!r} is not assigned in the "
+                        f"{tag} branch of a tensor-dependent `if`; it must "
+                        "either exist before the `if` or be assigned in both "
+                        "branches"
+                    )
+                if not _is_arraylike(v):
+                    raise TypeError(
+                        f"dy2static: variable {name!r} is assigned a "
+                        f"non-tensor value ({type(v).__name__}) inside a "
+                        "tensor-dependent `if`; only tensor/number values can "
+                        "be threaded through lax.cond"
+                    )
+            return tuple(_as_tensor(v) for v in out)
+
+        return run
+
+    out = snn.cond(pred, _branch(true_fn, "true"), _branch(false_fn, "false"),
+                   operands=operands)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    set_state(tuple(out))
+
+
+def convert_while(test_fn, body_fn, get_state, set_state, names):
+    """Runtime dispatch for a transformed ``while`` (reference
+    ``convert_operators.py convert_while_loop``)."""
+    t = _concrete_bool(test_fn())
+    if t is not None:
+        while t:
+            body_fn()
+            t = _concrete_bool(test_fn())
+            if t is None:
+                raise ValueError(
+                    "dy2static: `while` condition became tensor-symbolic "
+                    "mid-loop; hoist the symbolic state into the condition "
+                    "before the loop"
+                )
+        return
+
+    from ..static import nn as snn
+
+    init = list(get_state())
+    for name, v in zip(names, init):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: loop variable {name!r} must be defined before a "
+                "tensor-dependent `while`"
+            )
+        if not _is_arraylike(v):
+            raise TypeError(
+                f"dy2static: loop variable {name!r} has non-tensor type "
+                f"{type(v).__name__}; tensor-dependent `while` loops can only "
+                "carry tensor/number state"
+            )
+
+    def cond_w(*vals):
+        set_state(tuple(vals))
+        return test_fn()
+
+    def body_w(*vals):
+        set_state(tuple(vals))
+        body_fn()
+        return tuple(_as_tensor(v) for v in get_state())
+
+    out = snn.while_loop(cond_w, body_w, [_as_tensor(v) for v in init])
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    set_state(tuple(out))
+
+
+def convert_for_range(range_args, body_fn, get_state, set_state, names,
+                      target_first=True):
+    """Transformed ``for i in range(...)``: python loop when the bounds are
+    concrete, counter-carried ``lax.while_loop`` otherwise. The loop target
+    is ``names[0]`` and is assigned by the body each iteration (so, as in
+    plain Python, it holds the final index after the loop)."""
+    args = [a.item() if hasattr(a, "item") and _concrete_bool(a) is not None
+            else a for a in range_args]
+    concrete = all(_concrete_bool(a) is not None or isinstance(a, (int, np.integer))
+                   for a in args)
+    # normalize to (start, stop, step)
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+
+    if concrete:
+        for i in range(int(start), int(stop), int(step)):
+            body_fn(i)
+        return
+
+    if isinstance(step, (int, np.integer)) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+
+    from ..framework.tensor import Tensor
+    from ..static import nn as snn
+
+    init = list(get_state())
+    if target_first and names and init[0] is UNDEF:
+        # the target is only ever written by the loop itself; seed it with
+        # `start` so zero-trip symbolic loops still produce a defined value
+        init[0] = jnp.asarray(getattr(start, "_value", start), jnp.int32)
+    for name, v in zip(names, init):
+        if v is UNDEF:
+            raise ValueError(
+                f"dy2static: loop variable {name!r} must be defined before a "
+                "tensor-dependent `for`"
+            )
+
+    start_t = jnp.asarray(getattr(start, "_value", start), jnp.int32)
+    stop_t = jnp.asarray(getattr(stop, "_value", stop), jnp.int32)
+    step_t = jnp.asarray(getattr(step, "_value", step), jnp.int32)
+    # python-range trip count, valid for either step sign
+    trips = jnp.maximum(0, (stop_t - start_t + step_t
+                            - jnp.sign(step_t)) // step_t)
+
+    def cond_w(k, *vals):
+        return Tensor(k._value < trips)
+
+    def body_w(k, *vals):
+        set_state(tuple(vals))
+        body_fn(Tensor(start_t + k._value * step_t))
+        new = tuple(_as_tensor(v) for v in get_state())
+        return (Tensor(k._value + 1),) + new
+
+    out = snn.while_loop(
+        cond_w, body_w, [Tensor(jnp.asarray(0, jnp.int32))] + [_as_tensor(v) for v in init]
+    )
+    out = out if isinstance(out, (tuple, list)) else (out,)
+    set_state(tuple(out[1:]))
+
+
+def convert_logical_and(*fns):
+    """Lazy ``and`` preserving Python short-circuit on concrete operands.
+    Symbolic operands combine through the framework's logical_and op so the
+    expression records in static mode and traces under jit."""
+    from ..ops import logic
+
+    for i, f in enumerate(fns):
+        val = f()
+        c = _concrete_bool(val)
+        if c is None:
+            res = _bool_tensor(val)
+            for g in fns[i + 1:]:
+                res = logic.logical_and(res, _bool_tensor(g()))
+            return res
+        if not c:
+            return val
+    return val
+
+
+def convert_logical_or(*fns):
+    from ..ops import logic
+
+    for i, f in enumerate(fns):
+        val = f()
+        c = _concrete_bool(val)
+        if c is None:
+            res = _bool_tensor(val)
+            for g in fns[i + 1:]:
+                res = logic.logical_or(res, _bool_tensor(g()))
+            return res
+        if c:
+            return val
+    return val
+
+
+def convert_logical_not(val):
+    from ..ops import logic
+
+    c = _concrete_bool(val)
+    if c is None:
+        return logic.logical_not(_bool_tensor(val))
+    return not c
+
+
+def _bool_tensor(v):
+    """As a bool Tensor/Variable, via the recorded cast for symbolic args."""
+    T = _tensor_mod()
+    if not isinstance(v, T.Tensor):
+        return T.Tensor(jnp.asarray(v).astype(jnp.bool_))
+    if str(v.dtype).endswith("bool"):
+        return v
+    return v.astype("bool")
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef,
+                ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names assigned at THIS scope level (does not descend into nested
+    function/class/comprehension scopes)."""
+
+    def __init__(self):
+        self.names = []
+
+    def visit(self, node):
+        if isinstance(node, _SCOPE_NODES):
+            # the def's own name is a store in this scope
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                self._add(node.name)
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+        super().generic_visit(node)
+
+    def _add(self, name):
+        if name not in self.names:
+            self.names.append(name)
+
+
+def _assigned_names(stmts):
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _EarlyExitFinder(ast.NodeVisitor):
+    """Detects return/break/continue at this scope level (not inside nested
+    defs; break/continue inside nested loops don't count)."""
+
+    def __init__(self):
+        self.has_return = False
+        self.has_break = False
+
+    def visit(self, node):
+        if isinstance(node, _SCOPE_NODES):
+            return
+        if isinstance(node, ast.Return):
+            self.has_return = True
+        if isinstance(node, (ast.Break, ast.Continue)):
+            self.has_break = True
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            # break/continue inside belong to that loop; returns still escape
+            for s in node.body + node.orelse:
+                sub = _EarlyExitFinder()
+                sub.visit(s)
+                self.has_return = self.has_return or sub.has_return
+            return
+        super().generic_visit(node)
+
+
+def _blocks_transform(stmts):
+    f = _EarlyExitFinder()
+    for s in stmts:
+        f.visit(s)
+    return f.has_return or f.has_break
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """``and``/``or``/``not`` → lazy converters. Applied ONLY inside
+    ``if``/``while`` test expressions (reference logical_transformer.py
+    converts everywhere; restricting to tests preserves Python's
+    value-returning `x or default` idiom in ordinary expressions)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("_jst.convert_logical_and" if isinstance(node.op, ast.And)
+              else "_jst.convert_logical_or")
+        lambdas = [ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[], kwarg=None,
+                               defaults=[]),
+            body=v) for v in node.values]
+        (call,) = _parse_stmts(f"{fn}()")
+        call.value.args = lambdas
+        return ast.copy_location(call.value, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        (call,) = _parse_stmts("_jst.convert_logical_not(0)")
+        call.value.args[0] = node.operand
+        return ast.copy_location(call.value, node)
+
+    # do not descend into nested lambdas' bodies beyond normal semantics
+    def visit_Lambda(self, node):
+        return node
+
+
+def _convert_test(expr):
+    new = _LogicalTransformer().visit(expr)
+    ast.fix_missing_locations(new)
+    return new
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+
+def _parse_stmts(src):
+    return ast.parse(textwrap.dedent(src)).body
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _n(self):
+        self.counter += 1
+        return self.counter
+
+    # -- helpers ------------------------------------------------------------
+
+    def _state_defs(self, outs, n):
+        """try-bind each name (so `nonlocal` resolves) + get/set accessors."""
+        stmts = []
+        for name in outs:
+            stmts += _parse_stmts(
+                f"try:\n    {name} = {name}\n"
+                f"except (NameError, UnboundLocalError):\n    {name} = _jst.UNDEF\n"
+            )
+        nl = f"nonlocal {', '.join(outs)}" if outs else "pass"
+        tup = ", ".join(outs) + ("," if len(outs) == 1 else "")
+        get_src = f"def _pt_get_{n}():\n    return ({tup})\n"
+        set_src = (
+            f"def _pt_set_{n}(_pt_vals):\n    {nl}\n    ({tup}) = _pt_vals\n"
+            if outs else f"def _pt_set_{n}(_pt_vals):\n    pass\n"
+        )
+        if not outs:
+            get_src = f"def _pt_get_{n}():\n    return ()\n"
+        stmts += _parse_stmts(get_src) + _parse_stmts(set_src)
+        return stmts
+
+    def _body_fn(self, name, outs, body, params=""):
+        nl = [f"    nonlocal {', '.join(outs)}"] if outs else []
+        src = f"def {name}({params}):\n" + "\n".join(nl + ["    pass"])
+        (fdef,) = _parse_stmts(src)
+        fdef.body = fdef.body[:-1] + (body if body else [ast.Pass()])
+        return fdef
+
+    # -- visitors -----------------------------------------------------------
+
+    @staticmethod
+    def _outs(stmts, exclude=()):
+        """Names the block assigns, minus generated helpers (nested transforms
+        already rewrote inner nodes, planting _pt_* defs in the block)."""
+        outs = [o for o in _assigned_names(stmts)
+                if not o.startswith("_pt_") and o not in exclude]
+        # dunder-prefixed locals would be threaded incorrectly — bail the
+        # whole node (rare; keeps semantics over coverage)
+        if any(o.startswith("__") for o in outs):
+            return None
+        return outs
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _blocks_transform(node.body) or _blocks_transform(node.orelse):
+            return node
+        outs = self._outs(node.body + node.orelse)
+        if outs is None:
+            return node
+        n = self._n()
+        self.changed = True
+        stmts = self._state_defs(outs, n)
+        stmts.append(self._body_fn(f"_pt_true_{n}", outs, node.body))
+        stmts.append(self._body_fn(f"_pt_false_{n}", outs, node.orelse))
+        names_lit = repr(tuple(outs))
+        (call,) = _parse_stmts(
+            f"_jst.convert_ifelse(_pt_c, _pt_true_{n}, _pt_false_{n}, "
+            f"_pt_get_{n}, _pt_set_{n}, {names_lit})"
+        )
+        # splice the real test expression in place of the placeholder name
+        call.value.args[0] = _convert_test(node.test)
+        assign = ast.copy_location(call, node)
+        return [ast.copy_location(s, node) for s in stmts] + [assign]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _blocks_transform(node.body):
+            return node
+        outs = self._outs(node.body)
+        if outs is None:
+            return node
+        n = self._n()
+        self.changed = True
+        stmts = self._state_defs(outs, n)
+        # test closure reads enclosing locals directly
+        (test_fn,) = _parse_stmts(f"def _pt_test_{n}():\n    return 0\n")
+        test_fn.body = [ast.Return(value=_convert_test(node.test))]
+        stmts.append(test_fn)
+        stmts.append(self._body_fn(f"_pt_body_{n}", outs, node.body))
+        names_lit = repr(tuple(outs))
+        (call,) = _parse_stmts(
+            f"_jst.convert_while(_pt_test_{n}, _pt_body_{n}, "
+            f"_pt_get_{n}, _pt_set_{n}, {names_lit})"
+        )
+        return [ast.copy_location(s, node) for s in stmts] + [ast.copy_location(call, node)]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or _blocks_transform(node.body):
+            return node
+        # only `for <Name> in range(...)` is rewritten; other iterables keep
+        # python semantics (tensors iterate over a static leading dim)
+        if not (isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3
+                and not any(isinstance(a, ast.Starred) for a in node.iter.args)):
+            return node
+        body_outs = self._outs(node.body, exclude=(node.target.id,))
+        if body_outs is None:
+            return node
+        # target leads the state so it survives the loop (python leaves the
+        # loop variable bound to its final value)
+        outs = [node.target.id] + body_outs
+        n = self._n()
+        self.changed = True
+        stmts = self._state_defs(outs, n)
+        body = _parse_stmts(f"{node.target.id} = _pt_idx_{n}") + node.body
+        stmts.append(self._body_fn(f"_pt_body_{n}", outs, body,
+                                   params=f"_pt_idx_{n}"))
+        names_lit = repr(tuple(outs))
+        (call,) = _parse_stmts(
+            f"_jst.convert_for_range((), _pt_body_{n}, "
+            f"_pt_get_{n}, _pt_set_{n}, {names_lit})"
+        )
+        call.value.args[0] = ast.Tuple(elts=list(node.iter.args), ctx=ast.Load())
+        return [ast.copy_location(s, node) for s in stmts] + [ast.copy_location(call, node)]
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def _has_nonlocal(tree):
+    return any(isinstance(n, (ast.Nonlocal, ast.Global)) for n in ast.walk(tree))
+
+
+def convert_to_static(fn):
+    """Rewrite ``fn`` so Python control flow over tensors lowers to lax.
+
+    Returns ``fn`` unchanged when the source is unavailable, nothing needed
+    rewriting, or the function uses features outside the transform contract
+    (``nonlocal``/``global``, lambda)."""
+    if not _enabled:
+        return fn
+    raw = fn
+    if isinstance(fn, types.MethodType):
+        raw = fn.__func__
+    if getattr(raw, "_not_to_static", False) or getattr(raw, "_pt_converted", False):
+        return fn
+    if getattr(raw, "__name__", "<lambda>") == "<lambda>":
+        return fn
+    if hasattr(raw, "__wrapped__"):
+        # `raw` is a decorator wrapper (functools.wraps): inspect.getsource
+        # follows __wrapped__ to the INNER def, so recompiling here would
+        # silently drop the wrapping decorator's behavior — keep python
+        # semantics instead
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    if not tree.body or not isinstance(tree.body[0], (ast.FunctionDef,
+                                                      ast.AsyncFunctionDef)):
+        return fn
+    fdef = tree.body[0]
+    fdef.decorator_list = []
+    if _has_nonlocal(fdef):
+        return fn
+
+    tr = ControlFlowTransformer()
+    tr.visit(fdef)
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(tree)
+
+    freevars = raw.__code__.co_freevars
+    outer_name = "_pt_outer"
+    outer = ast.parse(
+        f"def {outer_name}({', '.join(freevars)}):\n    return None\n"
+    ).body[0]
+    outer.body = [fdef, ast.Return(value=ast.Name(id=fdef.name, ctx=ast.Load()))]
+    mod = ast.Module(body=[outer], type_ignores=[])
+    ast.fix_missing_locations(mod)
+
+    g = dict(raw.__globals__)
+    import paddle_tpu.jit.dy2static as _jst_mod
+
+    g["_jst"] = _jst_mod
+    code = compile(mod, filename=f"<dy2static {raw.__name__}>", mode="exec")
+    ns = {}
+    exec(code, g, ns)
+    new_fn = ns[outer_name](*([None] * len(freevars)))
+    if new_fn.__code__.co_freevars:
+        # share the ORIGINAL closure cells (matched by name — the rewritten
+        # code may reference a subset, possibly reordered) instead of
+        # snapshotting values: live rebinding of enclosing locals keeps
+        # working, and a not-yet-filled cell (recursive `@to_static def f`)
+        # resolves once the decorator returns
+        cells = tuple(
+            raw.__closure__[raw.__code__.co_freevars.index(name)]
+            for name in new_fn.__code__.co_freevars
+        )
+        new_fn = types.FunctionType(
+            new_fn.__code__, g, raw.__name__, raw.__defaults__, cells)
+    new_fn.__defaults__ = raw.__defaults__
+    new_fn.__kwdefaults__ = raw.__kwdefaults__
+    functools.update_wrapper(new_fn, raw, updated=())
+    new_fn._pt_converted = True
+    new_fn._pt_original = raw
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn

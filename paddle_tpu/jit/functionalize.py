@@ -104,6 +104,53 @@ def _wrap(x, stop_gradient=True):
     return x
 
 
+class _Dyn:
+    """Placeholder marking a dynamic (traced) leaf inside the static spec."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dyn>"
+
+
+_DYN = _Dyn()
+
+
+def _partition_args(args, kwargs):
+    """Split the (args, kwargs) tree into traced array leaves and a hashable
+    static remainder. Python scalars/strings are STATIC — they are op
+    attributes in the reference's ProgramDesc, not tensors — so a new value
+    recompiles rather than becoming a tracer (this is what lets python
+    control flow on them unroll at trace time)."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    dyn = []
+    spec = []
+    for leaf in leaves:
+        if (isinstance(leaf, (jax.Array, np.ndarray, np.generic))
+                or _is_tracer_val(leaf)):
+            dyn.append(leaf)
+            spec.append(_DYN)
+        else:
+            spec.append(leaf)
+    try:
+        hash(tuple(spec))
+        static = (treedef, tuple(spec))
+    except TypeError:
+        # unhashable static leaf: degrade to tracing everything
+        static = None
+    if static is None:
+        return leaves, (treedef, None)
+    return dyn, static
+
+
+def _is_tracer_val(x):
+    from ..framework.tensor import _is_tracer
+
+    return _is_tracer(x)
+
+
 class CompiledStep:
     """A cached compiled XLA step (≙ the reference's compiled-program cache in
     ``fluid/executor.py`` + InterpreterCore instruction list)."""
@@ -114,18 +161,25 @@ class CompiledStep:
         self._pure = self._build_pure()
         donate = (0,) if donate_state else ()
         self._jitted = jax.jit(
-            self._pure, donate_argnums=donate, static_argnames=static_argnames
+            self._pure, donate_argnums=donate, static_argnums=(2,),
+            static_argnames=static_argnames
         )
 
     def _build_pure(self):
         spec = self.spec
         fn = self.fn
 
-        def pure(state, args_tree):
+        def pure(state, dyn_leaves, static_spec):
+            treedef, static_leaves = static_spec
+            if static_leaves is None:
+                leaves = list(dyn_leaves)
+            else:
+                it = iter(dyn_leaves)
+                leaves = [next(it) if s is _DYN else s for s in static_leaves]
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             prev = spec.snapshot()
             spec.install(state)
             try:
-                args, kwargs = args_tree
                 t_args = jax.tree_util.tree_map(_wrap, args)
                 t_kwargs = jax.tree_util.tree_map(_wrap, kwargs)
                 out = fn(*t_args, **t_kwargs)
@@ -138,20 +192,23 @@ class CompiledStep:
 
         return pure
 
-    def __call__(self, *args, **kwargs):
-        state = self.spec.snapshot()
+    def _prepare(self, args, kwargs):
         arr_args = jax.tree_util.tree_map(_unwrap, args)
         arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
-        out_arrays, new_state = self._jitted(state, (arr_args, arr_kwargs))
+        return _partition_args(arr_args, arr_kwargs)
+
+    def __call__(self, *args, **kwargs):
+        state = self.spec.snapshot()
+        dyn, static = self._prepare(args, kwargs)
+        out_arrays, new_state = self._jitted(state, dyn, static)
         self.spec.install(new_state)
         self.spec.clear_grads()
         return jax.tree_util.tree_map(lambda a: _wrap(a), out_arrays)
 
     def lower(self, *args, **kwargs):
         state = self.spec.snapshot()
-        arr_args = jax.tree_util.tree_map(_unwrap, args)
-        arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
-        return self._jitted.lower(state, (arr_args, arr_kwargs))
+        dyn, static = self._prepare(args, kwargs)
+        return self._jitted.lower(state, dyn, static)
 
 
 def functionalize(fn=None, *, stateful=(), donate_state=True):
@@ -190,6 +247,14 @@ class StaticFunction:
         return self._compiled
 
     def __call__(self, *args, **kwargs):
+        from jax._src import core as _jcore
+
+        if not _jcore.trace_state_clean():
+            # already inside a trace (an enclosing CompiledStep, or this
+            # function calling itself): inline into the outer program — the
+            # reference likewise inlines nested to_static functions into one
+            # ProgramDesc rather than nesting executors
+            return self.fn(*args, **kwargs)
         return self._ensure()(*args, **kwargs)
 
     @property
@@ -203,19 +268,23 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """paddle.jit.to_static — here: jax.jit tracing instead of AST transpile.
+    """paddle.jit.to_static — jax.jit tracing + AST control-flow conversion.
 
-    Python control flow on traced values raises a clear jax error (the
-    reference rewrites if/for via AST transformers; the TPU-native contract is
-    lax.cond/scan via paddle_tpu.static.nn.cond/while_loop)."""
+    Tensor-dependent Python ``if``/``while``/``for range`` are rewritten by
+    :mod:`paddle_tpu.jit.dy2static` onto ``lax.cond``/``lax.while_loop``
+    (the reference's dygraph_to_static AST transpile, retargeted); constructs
+    outside the transform contract (early return under a tensor condition)
+    keep Python semantics and raise jax's concretization error under trace."""
+    from . import dy2static
 
     def deco(fn):
         if isinstance(fn, Layer):
             layer = fn
-            sf = StaticFunction(lambda *a, **k: layer.forward(*a, **k), layer=layer)
+            fwd = dy2static.convert_to_static(type(layer).forward)
+            sf = StaticFunction(lambda *a, **k: fwd(layer, *a, **k), layer=layer)
             layer.forward = sf
             return layer
-        return StaticFunction(fn)
+        return StaticFunction(dy2static.convert_to_static(fn))
 
     return deco(function) if function is not None else deco
 

@@ -76,6 +76,7 @@ from .loss import (  # noqa: F401
     triplet_margin_loss,
 )
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .sparse_attention import sparse_attention  # noqa: F401
 from ...ops.fused import fused_linear_cross_entropy  # noqa: F401
 from .vision import affine_grid, grid_sample  # noqa: F401
 from .sequence import sequence_mask  # noqa: F401

@@ -86,6 +86,27 @@ def _conv_raw(
 def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd):
     channel_last = data_format.endswith("C")
     pad_spec, _ = _norm_padding(padding, nd)
+    if not channel_last:
+        from ...framework.layout_autotune import layout_autotune_enabled
+
+        if layout_autotune_enabled():
+            # NCHW request under layout autotune: run the conv in NHWC (the
+            # TPU-preferred layout; reference imperative/layout_autotune.cc)
+            # and transpose back at the boundary
+            to_last = [0] + list(range(2, nd + 2)) + [1]
+            to_first = [0, nd + 1] + list(range(1, nd + 1))
+            out = _conv_raw(
+                x.transpose(to_last),
+                weight,
+                *([bias] if bias is not None else []),
+                stride=_norm_tuple(stride, nd),
+                padding=pad_spec,
+                dilation=_norm_tuple(dilation, nd),
+                groups=groups,
+                channel_last=True,
+                nd=nd,
+            )
+            return out.transpose(to_first)
     return _conv_raw(
         x,
         weight,

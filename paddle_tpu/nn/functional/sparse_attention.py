@@ -1,0 +1,78 @@
+"""nn.functional.sparse_attention (reference
+``python/paddle/nn/functional/sparse_attention.py`` → CUDA kernel
+``operators/sparse_attention_op.cu``: attention restricted to a per-row CSR
+pattern over the key positions).
+
+TPU-native: XLA has no scatter-style sparse MMA on the MXU; the efficient
+long-context path in this framework is the Pallas flash kernel with
+block-skipping (``ops/pallas/flash_attention.py``) and ring attention over
+the ``sep`` axis. This op therefore keeps the reference's *semantics* — only
+CSR-listed positions participate in the softmax — by materializing the
+pattern as an additive mask over score blocks, which XLA fuses into the
+attention matmuls. Intended for pattern-parity and moderate sizes, not as
+the perf kernel.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+__all__ = ["sparse_attention"]
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """query/key/value: [B, H, S, D]; offset: [B, H, S+1] int32 CSR row
+    offsets; columns: [B, H, NNZ] int32 column indices per row.
+
+    Returns softmax(QK^T/sqrt(D) over the CSR pattern) @ V.
+    """
+
+    def fwd(q, k, v, offset, cols, kpm, am):
+        b, h, s, d = q.shape
+        nnz = cols.shape[-1]
+        # CSR -> dense boolean mask [B, H, S, S] without data-dependent
+        # shapes: position j participates in row i iff some t in
+        # [offset[i], offset[i+1]) has cols[t] == j.
+        t_idx = jnp.arange(nnz)[None, None, None, :]                 # [1,1,1,NNZ]
+        row_lo = offset[..., :-1, None]                              # [B,H,S,1]
+        row_hi = offset[..., 1:, None]                               # [B,H,S,1]
+        in_row = (t_idx >= row_lo) & (t_idx < row_hi)                # [B,H,S,NNZ]
+        # one-hot of each nonzero's column, masked to its row, or-reduced
+        col_oh = jnp.zeros((b, h, s, s), dtype=bool)
+        # scatter via take: mask[b,h,i,j] = any(in_row & (cols==j))
+        cols_b = cols[..., None, :]                                  # [B,H,1,NNZ]
+        j_idx = jnp.arange(s)[None, None, :, None]                   # [1,1,S,1]
+        hit = (cols_b == j_idx)                                      # [B,H,S(NNZ j),NNZ]
+        # combine: for row i, allowed j iff exists t: in_row[i,t] and cols[t]==j
+        allowed = jnp.einsum("bhit,bhjt->bhij", in_row.astype(jnp.float32),
+                             hit.astype(jnp.float32)) > 0
+        del col_oh
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+        scores = jnp.einsum("bhid,bhjd->bhij", q, k) * scale
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+        scores = jnp.where(allowed, scores, neg)
+        if kpm is not None:
+            scores = jnp.where(kpm[:, None, None, :].astype(bool), scores, neg)
+        if am is not None:
+            scores = scores + am
+        p = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+        p = jnp.where(allowed, p, 0)
+        denom = p.sum(axis=-1, keepdims=True)
+        p = p / jnp.maximum(denom, jnp.asarray(1e-20, p.dtype))
+        return jnp.einsum("bhij,bhjd->bhid", p, v)
+
+    kpm = key_padding_mask if key_padding_mask is not None else None
+    am = attn_mask if attn_mask is not None else None
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
+    args.append(kpm if kpm is not None else jnp.zeros(0))
+    args.append(am if am is not None else jnp.zeros(0))
+
+    def fwd_outer(q, k, v, offset, cols, kpm_a, am_a):
+        kpm_x = kpm_a if kpm_a.size else None
+        am_x = am_a if am_a.size else None
+        return fwd(q, k, v, offset, cols, kpm_x, am_x)
+
+    return apply_op("sparse_attention", fwd_outer, tuple(args), {})

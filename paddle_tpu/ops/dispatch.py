@@ -93,6 +93,21 @@ def apply_op(name, fwd, args, static_kwargs):
     return _wrap_outputs(primal_out, node=node)
 
 
+def apply_nondiff_op(name, fwd, args, static_kwargs=None):
+    """Dispatch for ops with non-differentiable (bool/int) outputs:
+    participates in static Program recording like apply_op, but never
+    records a GradNode and skips the AMP per-op dtype policy (comparisons
+    are dtype-neutral; the reference registers compare/logical kernels
+    without grad ops and outside the amp op lists)."""
+    static_kwargs = static_kwargs or {}
+    if STATIC_RECORDER is not None:
+        recorded = STATIC_RECORDER(name, fwd, args, static_kwargs)
+        if recorded is not None:
+            return recorded
+    vals = [a._value if isinstance(a, Tensor) else a for a in args]
+    return _wrap_outputs(fwd(*vals, **static_kwargs), node=None)
+
+
 def _check_nan_inf(name, out):
     """FLAGS_check_nan_inf debug scan (reference
     ``framework/details/nan_inf_utils_detail.cc``; eager version

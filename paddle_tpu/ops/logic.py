@@ -4,27 +4,28 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
-from .dispatch import ensure_tensor
+from .dispatch import apply_nondiff_op, ensure_tensor
 
 
-def _logic(fn):
+def _logic(opname, fn):
     def api(x, y=None, out=None, name=None):
         if y is None:
-            return Tensor(fn(x._value))
+            return apply_nondiff_op(opname, fn, (x,))
         y = ensure_tensor(y, like=x)
-        return Tensor(fn(x._value, y._value))
+        return apply_nondiff_op(opname, fn, (x, y))
 
+    api.op_name = opname
     return api
 
 
-logical_and = _logic(jnp.logical_and)
-logical_or = _logic(jnp.logical_or)
-logical_xor = _logic(jnp.logical_xor)
-logical_not = _logic(jnp.logical_not)
-bitwise_and = _logic(jnp.bitwise_and)
-bitwise_or = _logic(jnp.bitwise_or)
-bitwise_xor = _logic(jnp.bitwise_xor)
-bitwise_not = _logic(jnp.bitwise_not)
+logical_and = _logic("logical_and", jnp.logical_and)
+logical_or = _logic("logical_or", jnp.logical_or)
+logical_xor = _logic("logical_xor", jnp.logical_xor)
+logical_not = _logic("logical_not", jnp.logical_not)
+bitwise_and = _logic("bitwise_and", jnp.bitwise_and)
+bitwise_or = _logic("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _logic("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _logic("bitwise_not", jnp.bitwise_not)
 
 
 def is_tensor(x):

@@ -9,7 +9,7 @@ from jax import lax
 
 from ..framework import dtype as dtypes
 from ..framework.tensor import Tensor
-from .dispatch import op, ensure_tensor
+from .dispatch import apply_nondiff_op, ensure_tensor, op
 
 # ---------------------------------------------------------------- binary ----
 
@@ -427,43 +427,37 @@ def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
 # ------------------------------------------------------------ logic-ish -----
 
 
-def equal(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value == y._value)
+def _cmp(opname, fn):
+    """Comparison dispatch: records in static mode, never grads (the
+    reference registers compare kernels without grad ops)."""
+
+    def api(x, y, name=None):
+        y = ensure_tensor(y, like=x)
+        return apply_nondiff_op(opname, fn, (x, y))
+
+    api.op_name = opname
+    return api
 
 
-def not_equal(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value != y._value)
-
-
-def greater_than(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value > y._value)
-
-
-def greater_equal(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value >= y._value)
-
-
-def less_than(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value < y._value)
-
-
-def less_equal(x, y, name=None):
-    y = ensure_tensor(y, like=x)
-    return Tensor(x._value <= y._value)
+equal = _cmp("equal", lambda a, b: a == b)
+not_equal = _cmp("not_equal", lambda a, b: a != b)
+greater_than = _cmp("greater_than", lambda a, b: a > b)
+greater_equal = _cmp("greater_equal", lambda a, b: a >= b)
+less_than = _cmp("less_than", lambda a, b: a < b)
+less_equal = _cmp("less_equal", lambda a, b: a <= b)
 
 
 def equal_all(x, y, name=None):
-    return Tensor(jnp.array_equal(x._value, y._value))
+    return apply_nondiff_op("equal_all", jnp.array_equal, (x, y))
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.allclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    return apply_nondiff_op(
+        "allclose", jnp.allclose, (x, y),
+        {"rtol": rtol, "atol": atol, "equal_nan": equal_nan})
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.isclose(x._value, y._value, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    return apply_nondiff_op(
+        "isclose", jnp.isclose, (x, y),
+        {"rtol": rtol, "atol": atol, "equal_nan": equal_nan})

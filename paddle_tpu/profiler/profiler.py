@@ -109,6 +109,50 @@ def _default_state_scheduler(step: int):
 _local = threading.local()
 _ACTIVE_PROFILERS = []
 
+# native RecordEvent sink (core/native/host_tracer.cc ≙ the reference's C++
+# host_tracer): one ctypes call per span instead of python object churn.
+# Drained into _HostEvents at finalize; python path is the fallback.
+_native_state = {"lib": None, "active": False, "owner": None}
+_TYPE_SEP = "\x1f"
+
+
+def _start_native_tracer(owner):
+    from .. import core
+
+    lib = core.load_native()
+    if lib is not None:
+        lib.pt_tracer_start(1_000_000)
+        _native_state.update(lib=lib, active=True, owner=owner)
+
+
+def _drain_native_tracer(events):
+    import ctypes
+
+    lib = _native_state["lib"]
+    if not _native_state["active"] or lib is None:
+        return
+    lib.pt_tracer_stop()
+    n = int(lib.pt_tracer_count())
+    if n:
+        buflen = 160 * n + 1024
+        buf = ctypes.create_string_buffer(buflen)
+        rc = int(lib.pt_tracer_dump(buf, buflen))
+        if rc < 0:
+            buf = ctypes.create_string_buffer(-rc)
+            rc = int(lib.pt_tracer_dump(buf, -rc))
+        for line in buf.raw[:max(rc, 0)].decode(errors="replace").splitlines():
+            try:
+                name, s, e, tid = line.rsplit("\t", 3)
+            except ValueError:
+                continue
+            etype = "PythonUserDefined"
+            if _TYPE_SEP in name:
+                name, etype = name.rsplit(_TYPE_SEP, 1)
+            events.append(_HostEvent(name, etype, int(tid), int(s), int(e)))
+        lib.pt_tracer_clear()
+    _native_state["active"] = False
+    _native_state["owner"] = None
+
 
 def in_profiler_mode():
     return bool(_ACTIVE_PROFILERS)
@@ -173,11 +217,20 @@ class RecordEvent:
         if self._jax_ann is not None:
             self._jax_ann.__exit__(None, None, None)
             self._jax_ann = None
-        ev = _HostEvent(self.name, self.event_type, threading.get_ident(),
-                        self._start_ns, end_ns)
+        start_ns = self._start_ns
         self._start_ns = None
+        handled = None
+        if _native_state["active"]:
+            tag = f"{self.name}{_TYPE_SEP}{self.event_type}".encode()
+            if _native_state["lib"].pt_tracer_record(tag, start_ns, end_ns) == 0:
+                handled = _native_state["owner"]
+        if handled is not None and all(p is handled for p in _ACTIVE_PROFILERS):
+            return
+        ev = _HostEvent(self.name, self.event_type, threading.get_ident(),
+                        start_ns, end_ns)
         for prof in _ACTIVE_PROFILERS:
-            prof._record(ev)
+            if prof is not handled:  # the owner drains the native buffer
+                prof._record(ev)
 
 
 def wrap_optimizers():
@@ -345,6 +398,13 @@ class Profiler:
             if self.on_trace_ready:
                 self.on_trace_ready(self)
             self._events = []
+        elif (self.current_state not in (ProfilerState.RECORD,
+                                         ProfilerState.RECORD_AND_RETURN)
+              and _native_state["active"]
+              and _native_state["owner"] is self):
+            # leaving a record window without returning: keep the spans,
+            # stop native collection so non-record phases aren't captured
+            _drain_native_tracer(self._events)
         self._maybe_toggle_device()
         self._step_t0 = time.perf_counter()
 
@@ -365,6 +425,10 @@ class Profiler:
     def _maybe_toggle_device(self):
         recording = self.current_state in (ProfilerState.RECORD,
                                            ProfilerState.RECORD_AND_RETURN)
+        if (recording and not _native_state["active"]
+                and len(_ACTIVE_PROFILERS) == 1
+                and ProfilerTarget.CPU in self.targets):
+            _start_native_tracer(self)
         if recording and self._wants_device() and not self._device_tracing:
             import tempfile
 
@@ -388,6 +452,8 @@ class Profiler:
             self._device_tracing = False
 
     def _finalize(self):
+        if _native_state["owner"] is self:
+            _drain_native_tracer(self._events)
         self.profiler_result = ProfilerResult(
             self._events,
             extra_info={"steps": self.step_num},

@@ -9,6 +9,7 @@ recording a static Program.
 from __future__ import annotations
 
 import contextlib
+import types
 
 import jax
 from jax import lax
@@ -82,6 +83,17 @@ def _closure_tensors(*fns):
         elif getattr(v, "__self__", None) is not None:
             # bound method: scan the receiver (a Layer holding params, say)
             add(v.__self__, depth + 1)
+        elif isinstance(v, types.FunctionType):
+            # nested closure (e.g. dy2static branch wrappers close over the
+            # user's branch fn, which closes over the tensors)
+            seen.add(id(v))
+            for cell in (v.__closure__ or ()):
+                try:
+                    add(cell.cell_contents, depth + 1)
+                except ValueError:
+                    pass
+            for d in (v.__defaults__ or ()):
+                add(d, depth + 1)
 
     for fn in fns:
         for cell in (getattr(fn, "__closure__", None) or ()):

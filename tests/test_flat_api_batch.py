@@ -1,0 +1,218 @@
+"""Flat API batch: paddle.device, distributed.spawn, sparse_attention,
+layout autotune, auto_checkpoint, cost_model, incubate.multiprocessing.
+
+Reference parity targets noted per test.
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+# -- paddle.device (reference python/paddle/device/) ------------------------
+
+
+def test_device_namespace():
+    assert "cpu" in paddle.device.get_all_device_type()
+    devs = paddle.device.get_available_device()
+    assert devs and all(":" in d for d in devs)
+    assert paddle.device.device_count() >= 1
+    paddle.device.synchronize()  # must not raise
+
+
+def test_device_stream_event():
+    ev = paddle.device.cuda.Event()
+    assert ev.query()  # nothing recorded yet
+    s = paddle.device.cuda.current_stream()
+    x = paddle.to_tensor(np.ones((64, 64), np.float32))
+    y = x @ x
+    ev.record()
+    ev.synchronize()
+    assert ev.query()
+    with paddle.device.cuda.stream_guard(s):
+        z = y + 1
+    s.synchronize()
+    assert float(z.numpy()[0, 0]) == 65.0
+    assert paddle.device.cuda.memory_allocated() >= 0
+    props = paddle.device.cuda.get_device_properties()
+    assert "platform" in props
+
+
+# -- sparse_attention (reference nn/functional/sparse_attention.py) ---------
+
+
+def _dense_ref(q, k, v, mask):
+    d = q.shape[-1]
+    s = (q @ np.swapaxes(k, -1, -2)) / np.sqrt(d)
+    s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = np.where(mask, p, 0)
+    p = p / np.maximum(p.sum(-1, keepdims=True), 1e-20)
+    return p @ v
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.RandomState(0)
+    b, h, s, d = 1, 2, 8, 4
+    q, k, v = (rng.randn(b, h, s, d).astype(np.float32) for _ in range(3))
+    # band pattern: each row attends to itself and its left neighbor
+    offset = np.zeros((b, h, s + 1), np.int32)
+    cols_list = [[max(i - 1, 0), i] if i > 0 else [0] for i in range(s)]
+    flat = [c for row in cols_list for c in row]
+    counts = [len(row) for row in cols_list]
+    offset[..., 1:] = np.cumsum(counts)
+    cols = np.tile(np.asarray(flat, np.int32), (b, h, 1))
+
+    out = F.sparse_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(offset), paddle.to_tensor(cols))
+
+    mask = np.zeros((b, h, s, s), bool)
+    for i, row in enumerate(cols_list):
+        for j in row:
+            mask[..., i, j] = True
+    ref = _dense_ref(q, k, v, mask)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_gradient_respects_pattern():
+    rng = np.random.RandomState(1)
+    b, h, s, d = 1, 1, 4, 2
+    q = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32),
+                         stop_gradient=False)
+    k = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(b, h, s, d).astype(np.float32))
+    # diagonal-only pattern
+    offset = paddle.to_tensor(np.arange(s + 1, dtype=np.int32)[None, None])
+    cols = paddle.to_tensor(np.arange(s, dtype=np.int32)[None, None])
+    out = F.sparse_attention(q, k, v, offset, cols)
+    out.sum().backward()
+    assert q.grad is not None
+    # diagonal softmax over one element == identity: output is v exactly
+    np.testing.assert_allclose(out.numpy(), v.numpy(), rtol=1e-5, atol=1e-6)
+
+
+# -- layout autotune (reference imperative/layout_autotune.cc) --------------
+
+
+def test_layout_autotune_conv_parity():
+    import paddle_tpu.incubate.autotune as autotune
+    from paddle_tpu.framework.layout_autotune import layout_autotune_enabled
+
+    rng = np.random.RandomState(2)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(4, 3, 3, 3).astype(np.float32))
+    base = F.conv2d(x, w, stride=1, padding=1).numpy()
+    try:
+        autotune.set_config({"layout": {"enable": True}})
+        assert layout_autotune_enabled()
+        tuned = F.conv2d(x, w, stride=1, padding=1).numpy()
+    finally:
+        autotune.set_config({"layout": {"enable": False}})
+    assert not layout_autotune_enabled()
+    np.testing.assert_allclose(tuned, base, rtol=1e-4, atol=1e-4)
+
+
+# -- auto checkpoint (reference fluid/incubate/checkpoint/auto_checkpoint.py)
+
+
+def test_auto_checkpoint_resumes(tmp_path):
+    import paddle_tpu.incubate.checkpoint.auto_checkpoint as acp
+
+    save_dir = str(tmp_path / "acp")
+
+    def run(n_epochs, crash_after=None):
+        acp.reset()
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        acp.register(model=net, optimizer=opt)
+        seen = []
+        for epoch in acp.train_epoch_range(n_epochs, save_dir=save_dir):
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            (net(x) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+            seen.append(epoch)
+            if crash_after is not None and epoch == crash_after:
+                break  # simulated failure: no further saves
+        return seen, net
+
+    first, net1 = run(6, crash_after=2)
+    assert first == [0, 1, 2]
+    # the break happens inside epoch 2 BEFORE its end-of-epoch snapshot, so a
+    # correct resume redoes epoch 2 from the epoch-1 checkpoint (the
+    # reference's semantics: an epoch counts only once its snapshot lands)
+    second, net2 = run(6)
+    assert second == [2, 3, 4, 5]
+    # restored params at epoch 3 came from the epoch-2 snapshot
+    assert os.path.exists(os.path.join(save_dir, "acp_meta.json"))
+
+
+# -- cost model (reference python/paddle/cost_model/) -----------------------
+
+
+def test_cost_model_static_and_measured():
+    import jax.numpy as jnp
+
+    cm = paddle.cost_model.CostModel()
+
+    def f(a, b):
+        return jnp.dot(a, b)
+
+    a = np.ones((128, 128), np.float32)
+    static = cm.static_cost_data(f, (a, a))
+    assert static["flops"] > 0
+    measured = cm.profile_measure(f, (a, a), repeat=3, warmup=1)
+    assert measured["mean_seconds"] > 0
+    assert measured["achieved_flops_per_sec"] > 0
+
+
+# -- incubate.multiprocessing reductions ------------------------------------
+
+
+def test_tensor_pickles_across_process_boundary():
+    import paddle_tpu.incubate.multiprocessing  # noqa: F401 — installs reducers
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t.stop_gradient = False
+    blob = pickle.dumps(t)
+    t2 = pickle.loads(blob)
+    np.testing.assert_array_equal(t2.numpy(), t.numpy())
+    assert t2.stop_gradient is False
+
+
+# -- distributed.spawn (reference python/paddle/distributed/spawn.py) -------
+
+
+def _spawn_target(result_dir):
+    # runs in a fresh process: the env surface must be present
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    n = os.environ["PADDLE_TRAINERS_NUM"]
+    with open(os.path.join(result_dir, f"rank{rank}"), "w") as f:
+        f.write(f"{rank}/{n}")
+
+
+def test_spawn_runs_workers(tmp_path):
+    paddle.distributed.spawn(_spawn_target, args=(str(tmp_path),), nprocs=2)
+    got = sorted(os.listdir(tmp_path))
+    assert got == ["rank0", "rank1"]
+    assert (tmp_path / "rank0").read_text() == "0/2"
+
+
+def test_spawn_propagates_failure(tmp_path):
+    def boom(_):
+        raise RuntimeError("worker exploded")
+
+    # note: nested functions aren't picklable under spawn; module-level
+    # failure path is exercised via a lambda-free helper
+    with pytest.raises(RuntimeError):
+        paddle.distributed.spawn(_spawn_fail, args=(str(tmp_path),), nprocs=2)
+
+
+def _spawn_fail(_):
+    raise RuntimeError("worker exploded")

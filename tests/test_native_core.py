@@ -1,0 +1,141 @@
+"""Native (C++) runtime components: TCPStore + host tracer.
+
+Reference: ``distributed/store/tcp_store.cc`` (rendezvous KV + barriers) and
+``platform/profiler/host_tracer.cc`` (RecordEvent sink). Both are compiled
+from ``paddle_tpu/core/native/*.cc`` with g++ and bound via ctypes.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import TCPStore, load_native
+
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native toolchain unavailable")
+
+
+def test_store_set_get_add():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        master.set("k", b"v1")
+        assert master.get("k") == b"v1"
+        master.set("k", "v2")            # str values accepted
+        assert master.get("k") == b"v2"
+        assert master.add("ctr", 3) == 3
+        assert master.add("ctr", -1) == 2
+        master.wait(["k"])               # existing key returns immediately
+    finally:
+        master.close()
+
+
+def test_store_get_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    client = TCPStore("127.0.0.1", master.port, is_master=False, timeout=10)
+    try:
+        got = {}
+
+        def getter():
+            got["v"] = client.get("late-key", timeout=5)
+
+        t = threading.Thread(target=getter)
+        t.start()
+        master.set("late-key", b"payload")
+        t.join(5)
+        assert got.get("v") == b"payload"
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_timeout():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        with pytest.raises(Exception, match="timeout"):
+            master.get("never-set", timeout=0.2)
+    finally:
+        master.close()
+
+
+def test_store_large_value():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1, timeout=10)
+    try:
+        big = os.urandom(300_000)  # > the 64 KiB first-try buffer
+        master.set("big", big)
+        assert master.get("big") == big
+    finally:
+        master.close()
+
+
+_WORKER = r"""
+import sys
+from paddle_tpu.core import TCPStore
+
+rank, port = int(sys.argv[1]), int(sys.argv[2])
+store = TCPStore("127.0.0.1", port, is_master=False, world_size=2, timeout=30)
+store.set(f"rank{rank}/endpoint", f"10.0.0.{rank}:8{rank}00")
+peer = 1 - rank
+val = store.get(f"rank{peer}/endpoint").decode()
+assert val == f"10.0.0.{peer}:8{peer}00", val
+store.barrier("ready", world_size=2)
+n = store.add("done", 1)
+print(f"rank{rank} OK peer={val} done={n}")
+store.close()
+"""
+
+
+def test_store_two_process_rendezvous(tmp_path):
+    """The reference's test_tcp_store pattern: real processes exchange
+    endpoints through the store and pass a barrier."""
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=30)
+    try:
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER)
+        env = dict(os.environ, PYTHONPATH=os.getcwd())
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(r), str(master.port)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+                text=True)
+            for r in range(2)
+        ]
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        assert "rank0 OK peer=10.0.0.1:8100" in outs[0]
+        assert "rank1 OK peer=10.0.0.0:8000" in outs[1]
+        assert master.get("done")  # counter exists
+    finally:
+        master.close()
+
+
+def test_native_host_tracer_feeds_profiler(tmp_path):
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.profiler.profiler import _native_state
+
+    trace_path = str(tmp_path / "trace.json")
+    done = {}
+
+    def on_ready(prof):
+        prof.export(trace_path)
+        done["ok"] = True
+
+    with profiler.Profiler(targets=[profiler.ProfilerTarget.CPU],
+                           on_trace_ready=on_ready) as p:
+        assert _native_state["active"], "native tracer should be the sink"
+        with profiler.RecordEvent("native_span"):
+            np.dot(np.ones((64, 64)), np.ones((64, 64)))
+        with profiler.RecordEvent("other_span", "Operator"):
+            pass
+        p.step()
+    assert done.get("ok")
+    with open(trace_path) as f:
+        data = json.load(f)
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "native_span" in names and "other_span" in names
+    cats = {e["name"]: e["cat"] for e in data["traceEvents"]}
+    assert cats["other_span"] == "Operator"  # event type survives the dump

@@ -100,7 +100,12 @@ def test_table_is_large_enough():
 def test_op_gradient(entry):
     fn = _resolve(entry["api"])
     assert fn is not None, f"API {entry['api']} not found on the public surface"
-    rng = np.random.RandomState(abs(hash(entry["api"])) % (2**31))
+    # stable per-op seed: python's str hash is randomized per process
+    # (PYTHONHASHSEED), which made boundary-sensitive ops (grid_sample)
+    # flake run-to-run — crc32 is deterministic
+    import zlib
+
+    rng = np.random.RandomState(zlib.crc32(entry["api"].encode()) % (2**31))
 
     arrays = [_draw(s, d, rng) for s, d in entry["inputs"]]
     diffable = [
