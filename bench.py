@@ -138,6 +138,9 @@ def _run():
     for f in sorted(glob.glob("BENCH_r*.json")):
         try:
             d = json.load(open(f))
+            # the driver wraps our line under "parsed" in BENCH_r*.json
+            if isinstance(d.get("parsed"), dict):
+                d = d["parsed"]
             if d.get("unit") == "tokens/sec/chip":
                 prev = float(d.get("value", 0.0))
         except Exception:

@@ -17,6 +17,17 @@ from .bert import (  # noqa: F401
     bert_base,
     bert_large,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieModel,
+    ErnieForPretraining,
+    ErnieForSequenceClassification,
+    ErnieForTokenClassification,
+    ErnieForQuestionAnswering,
+    ErnieDataCollator,
+    ernie_base,
+    ernie_large,
+)
 
 __all__ = [
     "GPTConfig",
@@ -31,4 +42,13 @@ __all__ = [
     "BertForSequenceClassification",
     "bert_base",
     "bert_large",
+    "ErnieConfig",
+    "ErnieModel",
+    "ErnieForPretraining",
+    "ErnieForSequenceClassification",
+    "ErnieForTokenClassification",
+    "ErnieForQuestionAnswering",
+    "ErnieDataCollator",
+    "ernie_base",
+    "ernie_large",
 ]

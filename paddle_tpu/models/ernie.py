@@ -1,0 +1,291 @@
+"""ERNIE-family encoder models (PaddlePaddle's flagship NLP family).
+
+Reference scale target: the ERNIE configs the reference's hybrid-parallel
+stack trains (SURVEY §7 M5 "ERNIE/GPT-style pretrain"; the fleet tests
+model exactly this encoder shape). Architecturally ERNIE is a BERT-class
+encoder with two additions kept here:
+
+- a task-type embedding added into the input sum (ERNIE 2.0 continual
+  multi-task pretraining; ``use_task_id``),
+- sentence-order/NSP + MLM pretraining heads where the MLM projection is
+  tied to the word embedding and runs through the fused linear+CE op so the
+  ``[tokens, vocab]`` logits never materialize (ops/fused.py).
+
+The knowledge-masking (word/phrase/entity) pretraining strategy is a data
+pipeline concern; ``ErnieDataCollator`` implements span masking over
+host-side numpy batches for the DataLoader path.
+
+TPU notes: same mesh story as BERT/GPT — dp/sharding out of the box,
+Column/RowParallel layers for mp via the shared transformer stack.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .. import ops
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer, ParamAttr
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..ops.fused import fused_linear_cross_entropy
+
+__all__ = [
+    "ErnieConfig", "ErnieModel", "ErnieForPretraining",
+    "ErnieForSequenceClassification", "ErnieForTokenClassification",
+    "ErnieForQuestionAnswering", "ErnieDataCollator",
+    "ernie_base", "ernie_large",
+]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 513
+    type_vocab_size: int = 2
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    hidden_act: str = "gelu"
+
+
+def ernie_base():
+    return ErnieConfig()
+
+
+def ernie_large():
+    return ErnieConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                       intermediate_size=4096)
+
+
+class ErnieEmbeddings(Layer):
+    """word + position + sentence(token-type) [+ task] -> LN -> dropout."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        init = ParamAttr(initializer=Normal(std=cfg.initializer_range))
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size,
+                                               weight_attr=init)
+        self.use_task_id = cfg.use_task_id
+        if cfg.use_task_id:
+            self.task_type_embeddings = Embedding(cfg.task_type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout, mode="upscale_in_train")
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = Tensor(
+                np.arange(s, dtype=np.int64)[None, :].repeat(b, 0))
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = ops.zeros_like(input_ids)
+            h = h + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class ErniePooler(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, h):
+        return self.dense(h[:, 0]).tanh()
+
+
+class ErnieModel(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_dropout,
+            act_dropout=0.0, normalize_before=False,
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer, cfg.num_layers)
+        self.pooler = ErniePooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        mask = None
+        if attention_mask is not None:
+            if len(attention_mask.shape) == 2:
+                neg = (1.0 - attention_mask.astype("float32")) * -1e4
+                mask = neg.unsqueeze(1).unsqueeze(2)
+            else:
+                mask = attention_mask
+        out = self.encoder(h, src_mask=mask)
+        return out, self.pooler(out)
+
+
+class ErnieLMPredictionHead(Layer):
+    """transform -> LN -> tied-embedding projection (+bias). The projection
+    itself lives inside the fused linear+CE op at loss time."""
+
+    def __init__(self, cfg: ErnieConfig, embedding_weights):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = getattr(F, cfg.hidden_act)
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.decoder_weight = embedding_weights  # tied [vocab, hidden]
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+
+    def hidden(self, h):
+        return self.layer_norm(self.activation(self.transform(h)))
+
+    def forward(self, h):
+        h = self.hidden(h)
+        return ops.matmul(h, self.decoder_weight,
+                          transpose_y=True) + self.decoder_bias
+
+
+class ErnieForPretraining(Layer):
+    """MLM + sentence-order heads (reference ErnieForPretraining)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.lm_head = ErnieLMPredictionHead(
+            cfg, self.ernie.embeddings.word_embeddings.weight)
+        self.nsp_head = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids,
+                                 attention_mask=attention_mask,
+                                 task_type_ids=task_type_ids)
+        return self.lm_head(seq), self.nsp_head(pooled)
+
+    def loss(self, input_ids, mlm_labels, nsp_labels=None,
+             token_type_ids=None, attention_mask=None, task_type_ids=None,
+             ignore_index=-100):
+        """MLM (+ optional sentence-order) loss; mlm_labels uses -100 for
+        unmasked positions. The biased vocab projection goes through the
+        fused linear+CE kernel — logits never materialize."""
+        seq, pooled = self.ernie(input_ids, token_type_ids,
+                                 attention_mask=attention_mask,
+                                 task_type_ids=task_type_ids)
+        h = self.lm_head.hidden(seq)
+        mlm = fused_linear_cross_entropy(
+            h, self.lm_head.decoder_weight, mlm_labels,
+            bias=self.lm_head.decoder_bias, ignore_index=ignore_index)
+        if nsp_labels is None:
+            return mlm
+        nsp = F.cross_entropy(self.nsp_head(pooled),
+                              nsp_labels.reshape([-1, 1])).mean()
+        return mlm + nsp
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout if dropout is None else dropout)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask,
+                               task_type_ids=task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForTokenClassification(Layer):
+    def __init__(self, cfg: ErnieConfig, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout if dropout is None else dropout)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids,
+                            attention_mask=attention_mask,
+                            task_type_ids=task_type_ids)
+        return self.classifier(self.dropout(seq))
+
+
+class ErnieForQuestionAnswering(Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.classifier = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None):
+        seq, _ = self.ernie(input_ids, token_type_ids,
+                            attention_mask=attention_mask,
+                            task_type_ids=task_type_ids)
+        logits = self.classifier(seq)
+        return logits[:, :, 0], logits[:, :, 1]  # start, end
+
+
+class ErnieDataCollator:
+    """Knowledge-masking collator (host-side numpy): masks contiguous spans
+    (ERNIE's phrase/entity-level masking) instead of independent tokens.
+    Produces (input_ids, mlm_labels) with -100 on unmasked positions."""
+
+    def __init__(self, vocab_size, mask_token_id=3, mlm_prob=0.15,
+                 max_span=3, seed=0):
+        self.vocab_size = vocab_size
+        self.mask_token_id = mask_token_id
+        self.mlm_prob = mlm_prob
+        self.max_span = max_span
+        self.rng = np.random.RandomState(seed)
+
+    def __call__(self, batch_ids):
+        ids = np.array(batch_ids, dtype=np.int64, copy=True)
+        labels = np.full_like(ids, -100)
+        b, s = ids.shape
+        n_mask = min(max(1, int(s * self.mlm_prob)), s)
+        for i in range(b):
+            masked = 0
+            while masked < n_mask:
+                span = int(self.rng.randint(1, self.max_span + 1))
+                span = min(span, s)
+                # inclusive of start = s - span so the final token is maskable
+                start = int(self.rng.randint(0, s - span + 1))
+                for j in range(start, min(start + span, s)):
+                    if labels[i, j] != -100:
+                        continue
+                    labels[i, j] = ids[i, j]
+                    r = self.rng.rand()
+                    if r < 0.8:
+                        ids[i, j] = self.mask_token_id
+                    elif r < 0.9:
+                        ids[i, j] = self.rng.randint(0, self.vocab_size)
+                    masked += 1
+                if masked >= n_mask:
+                    break
+        return ids, labels

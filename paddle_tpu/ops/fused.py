@@ -42,13 +42,13 @@ def _chunked(x, chunk):
     return x.reshape((x.shape[0] // chunk, chunk) + x.shape[1:])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flce(h, w, labels, ignore_index, chunk):
-    losses, _ = _flce_fwd(h, w, labels, ignore_index, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flce(h, w, b, labels, ignore_index, chunk):
+    losses, _ = _flce_fwd(h, w, b, labels, ignore_index, chunk)
     return losses
 
 
-def _flce_fwd(h, w, labels, ignore_index, chunk):
+def _flce_fwd(h, w, b, labels, ignore_index, chunk):
     tokens = h.shape[0]
     chunk = chunk or _pick_chunk(tokens)
     y = labels.astype(jnp.int32)
@@ -59,7 +59,7 @@ def _flce_fwd(h, w, labels, ignore_index, chunk):
 
     def body(_, inp):
         h_c, y_c = inp
-        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32)  # [C,V]
+        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32) + b  # [C,V]
         m = jnp.max(logits, axis=-1)
         # one fused read pass computes both the exp-sum and the label logit
         # (iota-compare one-hot instead of gather: stays in the elementwise
@@ -73,11 +73,11 @@ def _flce_fwd(h, w, labels, ignore_index, chunk):
     _, (loss_b, lse_b) = lax.scan(body, None, (h_b, y_b))
     losses = loss_b.reshape(-1)[:tokens]
     losses = jnp.where(y == ignore_index, 0.0, losses)
-    return losses, (h, w, safe, y == ignore_index, lse_b)
+    return losses, (h, w, b, safe, y == ignore_index, lse_b)
 
 
 def _flce_bwd(ignore_index, chunk, res, g):
-    h, w, safe, ignored, lse_b = res
+    h, w, b, safe, ignored, lse_b = res
     tokens = h.shape[0]
     chunk = chunk or _pick_chunk(tokens)
     g = jnp.where(ignored, 0.0, g.astype(jnp.float32))
@@ -85,9 +85,10 @@ def _flce_bwd(ignore_index, chunk, res, g):
     y_b = _chunked(safe, chunk)
     g_b = _chunked(g, chunk)
 
-    def body(dw_acc, inp):
+    def body(acc, inp):
+        dw_acc, db_acc = acc
         h_c, y_c, g_c, lse_c = inp
-        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32)
+        logits = jnp.dot(h_c, w.T, preferred_element_type=jnp.float32) + b
         # softmax from the saved forward lse: single fused pass, no max/sum
         # re-reduction; one-hot via iota compare keeps this scatter-free
         eq = (lax.broadcasted_iota(jnp.int32, logits.shape, 1)
@@ -96,26 +97,36 @@ def _flce_bwd(ignore_index, chunk, res, g):
               * g_c[:, None]).astype(w.dtype)              # [C, V] bf16
         dh_c = jnp.dot(dl, w)                              # [C, H]
         dw_acc = dw_acc + jnp.dot(dl.T, h_c, preferred_element_type=jnp.float32)
-        return dw_acc, dh_c
+        if b.ndim == 0:
+            # bias-free path: the placeholder's cotangent is never consumed —
+            # skip the O(chunk*vocab) reduction entirely
+            pass
+        else:
+            db_acc = db_acc + jnp.sum(dl.astype(jnp.float32), axis=0)
+        return (dw_acc, db_acc), dh_c
 
     dw0 = jnp.zeros(w.shape, jnp.float32)
-    dw, dh_b = lax.scan(body, dw0, (h_b, y_b, g_b, lse_b))
+    db0 = jnp.zeros(b.shape, jnp.float32)
+    (dw, db), dh_b = lax.scan(body, (dw0, db0), (h_b, y_b, g_b, lse_b))
     dh = dh_b.reshape(-1, h.shape[-1])[:tokens].astype(h.dtype)
-    return dh, dw.astype(w.dtype), None
+    return dh, dw.astype(w.dtype), db.astype(b.dtype), None
 
 
 _flce.defvjp(_flce_fwd, _flce_bwd)
 
 
 @op("fused_linear_cross_entropy")
-def _flce_op(hidden, weight, labels, ignore_index=-100, reduction="mean",
-             chunk=0):
+def _flce_op(hidden, weight, labels, bias=None, ignore_index=-100,
+             reduction="mean", chunk=0):
     tokens = 1
     for d in hidden.shape[:-1]:
         tokens *= d
     h2 = hidden.reshape(tokens, hidden.shape[-1])
     y = labels.reshape(tokens)
-    losses = _flce(h2, weight, y, ignore_index, chunk)
+    # bias-free callers pay nothing: a scalar 0 broadcasts into the chunk
+    # logits and its (discarded) gradient is one extra scalar reduction
+    b = jnp.zeros((), jnp.float32) if bias is None else bias.astype(jnp.float32)
+    losses = _flce(h2, weight, b, y, ignore_index, chunk)
     if reduction == "none":
         return losses.reshape(labels.shape)
     valid = jnp.sum((y != ignore_index).astype(jnp.float32))
@@ -125,18 +136,24 @@ def _flce_op(hidden, weight, labels, ignore_index=-100, reduction="mean",
     return total / jnp.maximum(valid, 1.0)
 
 
-def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
-                               reduction="mean", chunk=0, name=None):
-    """``cross_entropy(hidden @ weight.T, labels)`` without materializing
-    logits.
+def fused_linear_cross_entropy(hidden, weight, labels, bias=None,
+                               ignore_index=-100, reduction="mean", chunk=0,
+                               name=None):
+    """``cross_entropy(hidden @ weight.T + bias, labels)`` without
+    materializing logits.
 
     Args:
         hidden: ``[..., hidden_size]`` activations (bf16/f32).
         weight: ``[vocab, hidden_size]`` LM head / tied embedding weight.
         labels: integer class ids, shape ``hidden.shape[:-1]``.
+        bias: optional ``[vocab]`` LM-head bias (ERNIE/BERT-style heads).
         ignore_index: label value excluded from the loss and the mean.
         reduction: ``"mean" | "sum" | "none"``.
         chunk: token-chunk size (0 = auto).
     """
-    return _flce_op(hidden, weight, labels, ignore_index=ignore_index,
+    if bias is None:
+        return _flce_op(hidden, weight, labels, ignore_index=ignore_index,
+                        reduction=reduction, chunk=int(chunk))
+    return _flce_op(hidden, weight, labels, bias,
+                    ignore_index=ignore_index,
                     reduction=reduction, chunk=int(chunk))

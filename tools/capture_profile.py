@@ -1,0 +1,81 @@
+"""Capture an XPlane device profile of the bench train step and print the
+top device ops by self time (parsed from the trace.json.gz the jax profiler
+writes). Run: PYTHONPATH=/root/.axon_site:/root/repo python tools/capture_profile.py
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import tempfile
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, max_position_embeddings=1024,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    batch, seq = 16, 1024
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    for name, sub in model.named_sublayers():
+        if type(sub).__name__ == "LayerNorm":
+            sub.to(dtype="float32")
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+
+    def full_step(ids, labels):
+        loss = model.loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = CompiledStep(full_step, stateful=[model, opt], donate_state=True)
+    rng = np.random.RandomState(0)
+    data = [Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+            for _ in range(8)]
+    for i in range(3):
+        np.asarray(step(data[i], data[i])._value)
+
+    d = tempfile.mkdtemp(prefix="xplane_")
+    with jax.profiler.trace(d):
+        outs = [step(data[3 + i], data[3 + i]) for i in range(4)]
+        np.asarray(outs[-1]._value)
+
+    time.sleep(2)
+    files = glob.glob(f"{d}/**/*.trace.json.gz", recursive=True)
+    print("trace files:", files)
+    if not files:
+        return
+    with gzip.open(files[0], "rt") as f:
+        trace = json.load(f)
+    events = [e for e in trace.get("traceEvents", [])
+              if e.get("ph") == "X" and e.get("dur")]
+    # keep device-lane events (TensorFlow Op / XLA Op names)
+    agg = {}
+    for e in events:
+        name = e.get("name", "")
+        agg.setdefault(name, [0, 0.0])
+        agg[name][0] += 1
+        agg[name][1] += e["dur"]
+    total = sum(v[1] for v in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:40]
+    print(f"{'name':<72} {'calls':>6} {'total_us':>12} {'%':>6}")
+    for name, (cnt, dur) in rows:
+        print(f"{name[:72]:<72} {cnt:>6} {dur:>12.0f} {100 * dur / total:>5.1f}%")
+
+
+if __name__ == "__main__":
+    main()
