@@ -87,6 +87,18 @@ from . import incubate  # noqa: F401
 from . import utils  # noqa: F401
 from . import device  # noqa: F401
 from . import cost_model  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import version  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import compat  # noqa: F401
+from . import reader  # noqa: F401
+from . import hub  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import dataset  # noqa: F401
+from . import inference  # noqa: F401
+from . import tensor  # noqa: F401
+from .batch import batch  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.model_summary import summary, flops  # noqa: F401
 from .framework.io import load, save  # noqa: F401

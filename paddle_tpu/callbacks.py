@@ -1,0 +1,11 @@
+"""paddle.callbacks — re-export of the hapi callback family
+(reference ``python/paddle/callbacks.py``)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+)

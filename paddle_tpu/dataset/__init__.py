@@ -1,0 +1,11 @@
+"""paddle.dataset (reference ``python/paddle/dataset/``: legacy reader-style
+dataset loaders — mnist.train() returns a sample generator).
+
+Offline policy: each loader yields from the framework's synthetic dataset
+surrogates (vision/datasets, text), keeping the generator item structure of
+the reference loaders.
+"""
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import uci_housing  # noqa: F401

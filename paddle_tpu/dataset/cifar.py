@@ -1,0 +1,20 @@
+"""paddle.dataset.cifar (reference ``dataset/cifar.py``)."""
+from ..vision.datasets import Cifar10
+
+
+def _reader(mode):
+    def reader():
+        ds = Cifar10(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1), int(label)
+
+    return reader
+
+
+def train10():
+    return _reader("train")
+
+
+def test10():
+    return _reader("test")

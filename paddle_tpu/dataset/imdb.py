@@ -1,0 +1,24 @@
+"""paddle.dataset.imdb (reference ``dataset/imdb.py``)."""
+from ..text import Imdb
+
+
+def _reader(mode):
+    def reader():
+        ds = Imdb(mode=mode)
+        for i in range(len(ds)):
+            doc, label = ds[i]
+            yield list(doc), int(label)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train")
+
+
+def test(word_idx=None):
+    return _reader("test")
+
+
+def word_dict():
+    return {i: i for i in range(5000)}

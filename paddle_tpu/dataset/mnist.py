@@ -1,0 +1,21 @@
+"""paddle.dataset.mnist (reference ``dataset/mnist.py``): sample readers
+yielding (image[784] float32 in [-1,1], label int)."""
+from ..vision.datasets import MNIST
+
+
+def _reader(mode):
+    def reader():
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1) * 2.0 - 1.0, int(label)
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

@@ -1,0 +1,20 @@
+"""paddle.dataset.uci_housing (reference ``dataset/uci_housing.py``)."""
+from ..text import UCIHousing
+
+
+def _reader(mode):
+    def reader():
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield x, y
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

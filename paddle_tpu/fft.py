@@ -1,0 +1,163 @@
+"""paddle.fft — discrete Fourier transform family.
+
+Reference: ``python/paddle/fft.py`` (c2c/r2c/c2r kernels
+``paddle/phi/kernels/*/fft_*``). TPU-native: every transform lowers to
+XLA's FFT HLO via ``jnp.fft`` inside the op dispatch, so transforms trace,
+jit, record into static Programs, and differentiate (FFT is linear — jax
+provides the exact vjp). ``norm`` semantics match the reference:
+``backward`` (no fwd scaling), ``forward`` (1/n fwd), ``ortho``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor
+from .ops.dispatch import apply_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _check_norm(norm):
+    if norm not in ("backward", "forward", "ortho"):
+        raise ValueError(
+            f"norm should be 'backward', 'forward' or 'ortho', got {norm!r}")
+    return norm
+
+
+def _op1(name, fn, x, n, axis, norm):
+    _check_norm(norm)
+
+    def fwd(a):
+        return fn(a, n=n, axis=axis, norm=norm)
+
+    return apply_op(name, fwd, (x,), {})
+
+
+def _opn(name, fn, x, s, axes, norm):
+    _check_norm(norm)
+    if s is not None and axes is not None and len(s) != len(axes):
+        raise ValueError(
+            f"length of s ({len(s)}) must equal length of axes ({len(axes)})")
+
+    def fwd(a):
+        return fn(a, s=s, axes=axes, norm=norm)
+
+    return apply_op(name, fwd, (x,), {})
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("fft", jnp.fft.fft, x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("ifft", jnp.fft.ifft, x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("rfft", jnp.fft.rfft, x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("irfft", jnp.fft.irfft, x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("hfft", jnp.fft.hfft, x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1("ihfft", jnp.fft.ihfft, x, n, axis, norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn("fftn", jnp.fft.fftn, x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn("ifftn", jnp.fft.ifftn, x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn("rfftn", jnp.fft.rfftn, x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn("irfftn", jnp.fft.irfftn, x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+
+    def fwd(a):
+        # hfftn = irfftn of the conjugate with forward/backward swapped scale
+        inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+        return jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes, norm=inv[norm])
+
+    return apply_op("hfftn", fwd, (x,), {})
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+
+    def fwd(a):
+        inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+        return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes, norm=inv[norm]))
+
+    return apply_op("ihfftn", fwd, (x,), {})
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn("fft2", jnp.fft.fft2, x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn("ifft2", jnp.fft.ifft2, x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn("rfft2", jnp.fft.rfft2, x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn("irfft2", jnp.fft.irfft2, x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes),
+                    (x,), {})
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes),
+                    (x,), {})

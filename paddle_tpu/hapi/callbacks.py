@@ -307,3 +307,60 @@ class EarlyStopping(Callback):
             if self.verbose:
                 print(f"Early stopping: monitored {self.monitor} did not "
                       f"improve for {self.patience} evals")
+
+
+class ReduceLROnPlateau(Callback):
+    """Reference ``callbacks.py`` ReduceLROnPlateau: scale the optimizer lr
+    by ``factor`` once the monitored metric plateaus for ``patience``
+    evals."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = np.inf if self.monitor_op == np.less else -np.inf
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.monitor_op(current - self.min_delta, self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter > 0:
+            # in cooldown: no plateau counting at all (reference semantics)
+            self.cooldown_counter -= 1
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.3e} -> {new:.3e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

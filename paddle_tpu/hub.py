@@ -1,0 +1,40 @@
+"""paddle.hub (reference ``python/paddle/hub.py``): load models from a
+repo. This environment has no egress — only ``source="local"`` works; the
+github/gitee sources raise with a clear message instead of hanging."""
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+
+def _local_entry(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError("paddle.hub: only source='local' is available "
+                           "in this offline build")
+    mod = _local_entry(repo_dir)
+    return [n for n in dir(mod) if callable(getattr(mod, n))
+            and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise RuntimeError("paddle.hub: only source='local' is available "
+                           "in this offline build")
+    return getattr(_local_entry(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise RuntimeError("paddle.hub: only source='local' is available "
+                           "in this offline build")
+    return getattr(_local_entry(repo_dir), model)(**kwargs)

@@ -1,0 +1,116 @@
+"""paddle.inference — deployment predictor API.
+
+Reference: ``paddle/fluid/inference/api/analysis_predictor.cc`` +
+``paddle_inference_api.h`` (Config → pass pipeline → NaiveExecutor).
+TPU-native: a saved model is a StableHLO program + weights
+(``paddle.jit.save``); the "pass pipeline" is XLA's compiler, and the
+predictor is a thin execution wrapper around the loaded
+:class:`~paddle_tpu.jit.TranslatedLayer` with the reference's
+handle-oriented API (get_input_names / copy_from_cpu / run /
+copy_to_cpu) so deployment code ports unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Reference ``AnalysisConfig``: model path + device knobs. GPU/IR
+    options are accepted for compatibility; XLA owns the optimization."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        self._path = prog_file
+        self._device = "tpu"
+        self._enabled_ir = True
+
+    def set_model(self, prog_file, params_file=None):
+        self._path = prog_file
+
+    def model_dir(self):
+        return self._path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "gpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def switch_ir_optim(self, flag=True):
+        self._enabled_ir = bool(flag)
+
+    def enable_memory_optim(self):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def summary(self):
+        return f"Config(path={self._path!r}, device={self._device})"
+
+
+class _Handle:
+    """In/out tensor handle (reference ``ZeroCopyTensor``)."""
+
+    def __init__(self):
+        self._arr = None
+
+    def copy_from_cpu(self, arr):
+        self._arr = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._arr is not None:
+            self._arr = self._arr.reshape(shape)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._arr)
+
+    def shape(self):
+        return list(self._arr.shape) if self._arr is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from .jit import load as jit_load
+
+        if config.model_dir() is None:
+            raise ValueError("Config has no model path; call set_model()")
+        self._layer = jit_load(config.model_dir())
+        n_in = len(getattr(self._layer, "_input_names", []) or []) or 1
+        self._in_names = (list(getattr(self._layer, "_input_names", []))
+                          or [f"input_{i}" for i in range(n_in)])
+        self._inputs = {n: _Handle() for n in self._in_names}
+        self._outputs = []
+
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def run(self):
+        from .framework.tensor import Tensor
+
+        args = [Tensor(self._inputs[n].copy_to_cpu()) for n in self._in_names]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = []
+        for o in outs:
+            h = _Handle()
+            h.copy_from_cpu(np.asarray(o.numpy()))
+            self._outputs.append(h)
+        return True
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name):
+        return self._outputs[int(name.split("_")[-1])]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

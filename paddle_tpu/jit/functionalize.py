@@ -30,16 +30,6 @@ from ..optimizer.optimizer import Optimizer
 __all__ = ["functionalize", "CompiledStep", "to_static", "not_to_static"]
 
 
-def _layer_state(layer: Layer):
-    state = {"params": {}, "buffers": {}}
-    for name, p in layer.named_parameters():
-        state["params"][name] = p._value
-    for name, b in layer.named_buffers():
-        if b is not None:
-            state["buffers"][name] = b._value
-    return state
-
-
 def _layer_refs(layer: Layer):
     refs = {"params": {}, "buffers": {}}
     for name, p in layer.named_parameters():
@@ -65,8 +55,15 @@ class _StateSpec:
         self._refs = [_layer_refs(l) for l in self.layers]
 
     def snapshot(self):
+        # read through the refs cached at construction instead of re-walking
+        # named_parameters() every step (the recursive layer traversal showed
+        # up as ~2 ms/step host time in the device profile)
         return {
-            "layers": [_layer_state(l) for l in self.layers],
+            "layers": [
+                {"params": {n: p._value for n, p in refs["params"].items()},
+                 "buffers": {n: b._value for n, b in refs["buffers"].items()}}
+                for refs in self._refs
+            ],
             "optimizers": [o._state_pytree() for o in self.optimizers],
             "others": [o._state_pytree() for o in self.others],
             "rng": rnd.default_generator.get_state(),

@@ -24,10 +24,16 @@ from .dispatch import op
 __all__ = ["fused_linear_cross_entropy"]
 
 
+# test/bench override for chunk-size sweeps (None = auto)
+_FORCE_CHUNK = None
+
+
 def _pick_chunk(tokens: int) -> int:
     # largest power-of-two chunk <= 2048 dividing the padded token count;
     # 2048x50k fp32 chunk logits ~ 400 MB transient, well inside HBM while
     # keeping the per-chunk matmul MXU-saturating.
+    if _FORCE_CHUNK:
+        return min(_FORCE_CHUNK, tokens)
     for c in (2048, 1024, 512, 256, 128):
         if tokens >= c:
             return c

@@ -1,0 +1,169 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Reference: ``python/paddle/signal.py`` (kernels ``phi/kernels/*/frame_*``,
+``overlap_add_*``, stft built from frame+matmul). TPU-native: framing is a
+gather-free strided reshape window (XLA lowers to slices), the DFT is the
+FFT HLO via :mod:`paddle_tpu.fft`, and overlap-add is a scatter-add the
+compiler fuses; everything traces/jits/differentiates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import fft as pfft
+from .framework.tensor import Tensor
+from .ops.dispatch import apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames of size ``frame_length`` every ``hop_length``
+    samples along ``axis`` (reference ``signal.py:32``). axis=-1 yields
+    ``[..., frame_length, num_frames]``; axis=0 yields
+    ``[num_frames, frame_length, ...]``."""
+    if frame_length <= 0 or hop_length <= 0:
+        raise ValueError("frame_length and hop_length must be positive")
+    size = x.shape[axis]
+    if frame_length > size:
+        raise ValueError(
+            f"frame_length ({frame_length}) > axis size ({size})")
+    n_frames = 1 + (size - frame_length) // hop_length
+
+    def fwd(a):
+        ax = axis % a.ndim
+        idx = (np.arange(frame_length)[:, None]
+               + hop_length * np.arange(n_frames)[None, :])  # [fl, nf]
+        out = jnp.take(a, jnp.asarray(idx.reshape(-1)), axis=ax)
+        shape = list(a.shape)
+        shape[ax:ax + 1] = [frame_length, n_frames]
+        out = out.reshape(shape)
+        if axis == 0:
+            # reference axis=0 convention: [num_frames, frame_length, ...]
+            out = jnp.swapaxes(out, 0, 1)
+        return out
+
+    return apply_op("frame", fwd, (x,), {})
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of :func:`frame`: add overlapping frames back
+    (reference ``signal.py:153``). axis=-1 input ``[..., frame_length,
+    num_frames]``; axis=0 input ``[num_frames, frame_length, ...]``."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+
+    def fwd(a):
+        if axis == 0:
+            a = jnp.swapaxes(a, 0, 1)  # -> [fl, nf, ...], frames at dim 1
+            fl, nf = a.shape[0], a.shape[1]
+            out_len = (nf - 1) * hop_length + fl
+            tail = a.shape[2:]
+            acc = jnp.zeros((out_len,) + tail, a.dtype)
+            idx = (np.arange(fl)[:, None]
+                   + hop_length * np.arange(nf)[None, :]).reshape(-1)
+            acc = acc.at[jnp.asarray(idx)].add(
+                a.reshape((fl * nf,) + tail))
+            return acc
+        fl, nf = a.shape[-2], a.shape[-1]
+        out_len = (nf - 1) * hop_length + fl
+        lead = a.shape[:-2]
+        acc = jnp.zeros(lead + (out_len,), a.dtype)
+        idx = (np.arange(fl)[:, None]
+               + hop_length * np.arange(nf)[None, :]).reshape(-1)
+        flat = a.reshape(lead + (fl * nf,))
+        acc = acc.at[..., jnp.asarray(idx)].add(flat)
+        return acc
+
+    return apply_op("overlap_add", fwd, (x,), {})
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference ``signal.py:237``).
+    x: ``[..., seq_len]`` real or complex; returns
+    ``[..., n_fft//2+1 | n_fft, num_frames]`` complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+        if w.shape[0] != win_length:
+            raise ValueError(
+                f"window length {w.shape[0]} != win_length {win_length}")
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    pad = (n_fft - win_length) // 2
+    if pad:
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    is_complex = "complex" in str(x.dtype)
+    if is_complex and onesided:
+        raise ValueError("onesided is not supported for complex input")
+
+    def fwd(a, wv):
+        if center:
+            pad_width = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad_width, mode=pad_mode)
+        size = a.shape[-1]
+        n_frames = 1 + (size - n_fft) // hop_length
+        idx = (np.arange(n_fft)[:, None]
+               + hop_length * np.arange(n_frames)[None, :]).reshape(-1)
+        frames = jnp.take(a, jnp.asarray(idx), axis=-1)
+        frames = frames.reshape(a.shape[:-1] + (n_fft, n_frames))
+        frames = frames * wv[:, None]
+        spec = (jnp.fft.rfft(frames, axis=-2) if (onesided and not is_complex)
+                else jnp.fft.fft(frames, axis=-2))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return apply_op("stft", fwd, (x, Tensor(w)), {})
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT, least-squares (NOLA-weighted) overlap-add
+    (reference ``signal.py:395``). x: ``[..., n_bins, num_frames]``."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    pad = (n_fft - win_length) // 2
+    if pad:
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def fwd(a, wv):
+        if onesided:
+            frames = jnp.fft.irfft(a, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(a, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        if normalized:
+            frames = frames * jnp.sqrt(jnp.asarray(n_fft, frames.dtype
+                                                   if frames.dtype != jnp.complex64
+                                                   else jnp.float32))
+        frames = frames * wv[:, None]
+        fl, nf = frames.shape[-2], frames.shape[-1]
+        out_len = (nf - 1) * hop_length + fl
+        lead = frames.shape[:-2]
+        idx = jnp.asarray((np.arange(fl)[:, None]
+                           + hop_length * np.arange(nf)[None, :]).reshape(-1))
+        acc = jnp.zeros(lead + (out_len,), frames.dtype)
+        acc = acc.at[..., idx].add(frames.reshape(lead + (fl * nf,)))
+        # NOLA normalization: divide by the summed squared window envelope
+        wsq = (wv ** 2)[:, None] * jnp.ones((1, nf), wv.dtype)
+        env = jnp.zeros((out_len,), wv.dtype)
+        env = env.at[idx].add(wsq.reshape(-1))
+        out = acc / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op("istft", fwd, (x, Tensor(w)), {})

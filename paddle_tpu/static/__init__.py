@@ -25,6 +25,7 @@ from .program import (
     in_static_build,
 )
 from .executor import Executor, CompiledProgram, global_scope
+from ..jit.save_load import InputSpec  # noqa: F401  (reference static/input.py)
 from .backward import append_backward
 from .io import save_inference_model, load_inference_model
 from . import nn

@@ -1,0 +1,16 @@
+"""paddle.sysconfig (reference ``python/paddle/sysconfig.py``)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """C headers dir (native core sources double as the include surface)."""
+    return os.path.join(_HERE, "core", "native")
+
+
+def get_lib():
+    """Directory holding the compiled native runtime library."""
+    return os.path.join(_HERE, "core", "_build")

@@ -1,0 +1,10 @@
+"""paddle.tensor — namespaced view of the tensor op surface.
+
+Reference: ``python/paddle/tensor/{math,manipulation,creation,linalg,
+logic,random,search,stat,einsum}.py``. The TPU build keeps ONE op registry
+(paddle_tpu.ops) and this module re-exports it under the reference's
+submodule names so ``paddle.tensor.math.add``-style imports resolve.
+"""
+from .ops import creation, einsum, linalg, logic, manipulation, math  # noqa: F401
+from .ops import random, search, stat  # noqa: F401
+from .ops import *  # noqa: F401,F403

@@ -1,0 +1,22 @@
+"""paddle.version (reference generated ``python/paddle/version.py``)."""
+full_version = "2.4.0+tpu"
+major = "2"
+minor = "4"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

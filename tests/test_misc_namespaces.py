@@ -1,0 +1,149 @@
+"""Small public namespaces: version/sysconfig/compat/batch/reader/hub/
+callbacks/dataset/tensor/inference.
+
+Reference files are noted per test; these are thin but real surfaces the
+reference user relies on.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_version_and_sysconfig():
+    assert paddle.version.full_version
+    assert paddle.version.cuda() is False
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    # get_lib points at the native build dir (created on first native use)
+    assert paddle.sysconfig.get_lib().endswith("_build")
+
+
+def test_compat_helpers():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert paddle.compat.floor_division(7, 2) == 3
+
+
+def test_batch_reader():
+    def reader():
+        yield from range(7)
+
+    batches = list(paddle.batch(reader, batch_size=3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(paddle.batch(reader, batch_size=3, drop_last=True)())
+    assert batches == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_reader_combinators():
+    r = paddle.reader
+
+    def nums():
+        yield from range(10)
+
+    assert list(r.firstn(nums, 3)()) == [0, 1, 2]
+    assert sorted(r.shuffle(nums, 4)()) == list(range(10))
+    assert list(r.chain(nums, nums)()) == list(range(10)) * 2
+    assert list(r.map_readers(lambda a, b: a + b, nums, nums)()) == [
+        2 * i for i in range(10)]
+    assert list(r.buffered(nums, 2)()) == list(range(10))
+    cached = r.cache(nums)
+    assert list(cached()) == list(range(10)) and list(cached()) == list(range(10))
+    out = list(r.xmap_readers(lambda v: v * 10, nums, 2, 4, order=True)())
+    assert out == [i * 10 for i in range(10)]
+    composed = r.compose(nums, nums)
+    assert list(composed())[0] == (0, 0)
+
+
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(scale=1):\n"
+        "    'build a tiny thing'\n"
+        "    return {'scale': scale}\n")
+    assert "tiny" in paddle.hub.list(str(tmp_path))
+    assert "tiny thing" in paddle.hub.help(str(tmp_path), "tiny")
+    assert paddle.hub.load(str(tmp_path), "tiny", scale=3) == {"scale": 3}
+    with pytest.raises(RuntimeError, match="offline"):
+        paddle.hub.load("user/repo", "x", source="github")
+
+
+def test_callbacks_namespace_and_reduce_lr():
+    import paddle_tpu.nn as nn
+
+    assert paddle.callbacks.EarlyStopping is not None
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, verbose=0)
+    cb.set_model(model) if hasattr(cb, "set_model") else setattr(cb, "model", model)
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 1.0})  # sets the baseline
+    cb.on_eval_end({"loss": 1.0})  # no improvement -> patience hit, reduce
+    assert float(opt.get_lr()) == pytest.approx(0.05)
+    cb.on_eval_end({"loss": 1.0})  # still flat -> second reduction
+    assert float(opt.get_lr()) == pytest.approx(0.025)
+    cb.on_eval_end({"loss": 0.1})  # improvement -> lr holds
+    assert float(opt.get_lr()) == pytest.approx(0.025)
+
+
+def test_reduce_lr_cooldown_suppresses_reductions():
+    import paddle_tpu.nn as nn
+
+    net = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, cooldown=2, verbose=0)
+    cb.model = model
+    cb.on_train_begin()
+    cb.on_eval_end({"loss": 1.0})  # baseline
+    cb.on_eval_end({"loss": 1.0})  # reduce -> 0.05, cooldown starts
+    assert float(opt.get_lr()) == pytest.approx(0.05)
+    cb.on_eval_end({"loss": 1.0})  # cooldown tick 1: NO reduction
+    cb.on_eval_end({"loss": 1.0})  # cooldown tick 2: NO reduction
+    assert float(opt.get_lr()) == pytest.approx(0.05)
+    cb.on_eval_end({"loss": 1.0})  # cooldown over -> plateau counts again
+    assert float(opt.get_lr()) == pytest.approx(0.025)
+
+
+def test_dataset_readers():
+    sample = next(paddle.dataset.mnist.train()())
+    assert sample[0].shape == (784,) and isinstance(sample[1], int)
+    x, y = next(paddle.dataset.uci_housing.train()())
+    assert x.shape == (13,)
+    doc, label = next(paddle.dataset.imdb.train()())
+    assert len(doc) > 0 and label in (0, 1)
+
+
+def test_tensor_namespace():
+    import paddle_tpu.tensor as T
+
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(T.math.add(a, a).numpy(), [2.0, 4.0])
+    assert T.concat is not None and T.linalg is not None
+
+
+def test_inference_predictor_roundtrip(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import save
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    ref = net(x).numpy()
+    path = str(tmp_path / "model")
+    save(net, path, input_spec=[paddle.static.InputSpec([3, 4], "float32")])
+
+    config = paddle.inference.Config(path)
+    predictor = paddle.inference.create_predictor(config)
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x.numpy())
+    assert predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5, atol=1e-6)

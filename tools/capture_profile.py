@@ -63,15 +63,32 @@ def main():
         trace = json.load(f)
     events = [e for e in trace.get("traceEvents", [])
               if e.get("ph") == "X" and e.get("dur")]
-    # keep device-lane events (TensorFlow Op / XLA Op names)
-    agg = {}
+    # The trace mixes host python lanes, module-level wrappers, and the
+    # flat XLA-op device lane — summing everything double-counts nested
+    # parents and mixes host time into the denominator. Aggregate ONLY
+    # within the (pid, tid) lane that holds the XLA fusion events; that
+    # lane is flat, so totals there are true self times.
+    lanes = {}
     for e in events:
+        lanes.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    xla_lane = None
+    for key, evs in lanes.items():
+        if any(e.get("name", "").startswith("fusion") for e in evs):
+            if xla_lane is None or (sum(x["dur"] for x in evs)
+                                    > sum(x["dur"] for x in lanes[xla_lane])):
+                xla_lane = key
+    if xla_lane is None:
+        print("no XLA op lane found in trace")
+        return
+    agg = {}
+    for e in lanes[xla_lane]:
         name = e.get("name", "")
         agg.setdefault(name, [0, 0.0])
         agg[name][0] += 1
         agg[name][1] += e["dur"]
     total = sum(v[1] for v in agg.values())
     rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:40]
+    print(f"device-op lane {xla_lane}: total {total/1e3:.1f} ms")
     print(f"{'name':<72} {'calls':>6} {'total_us':>12} {'%':>6}")
     for name, (cnt, dur) in rows:
         print(f"{name[:72]:<72} {cnt:>6} {dur:>12.0f} {100 * dur / total:>5.1f}%")
