@@ -201,6 +201,38 @@ def per_shard_fn_single(fn, x, g):
     )(x)
 
 
+def _mp_eager(g, x):
+    """True when running real multi-controller (``jax.process_count() > 1``),
+    the group spans all processes, and ``x`` is a process-local array. Eager
+    collectives then use CROSS-PROCESS semantics — each process contributes
+    its local value, exactly the reference's per-rank NCCL behavior — via
+    jax.experimental.multihost_utils, instead of the single-controller
+    stacked-global convention documented on each function."""
+    import jax
+
+    try:
+        n = jax.process_count()
+    except Exception:
+        return False
+    if n <= 1 or g.nranks != n or _in_spmd(g.axis_name):
+        return False
+    return bool(getattr(x, "is_fully_addressable", True))
+
+
+def _mp_axis_reduce(op, stacked):
+    if op == ReduceOp.SUM:
+        return jnp.sum(stacked, axis=0)
+    if op == ReduceOp.MAX:
+        return jnp.max(stacked, axis=0)
+    if op == ReduceOp.MIN:
+        return jnp.min(stacked, axis=0)
+    if op == ReduceOp.PROD:
+        return jnp.prod(stacked, axis=0).astype(stacked.dtype)
+    if op == ReduceOp.AVG:
+        return jnp.mean(stacked, axis=0)
+    raise ValueError(f"unknown ReduceOp {op}")
+
+
 def _reduce_fn(op, axis):
     if op == ReduceOp.SUM:
         return lambda x: lax.psum(x, axis)
@@ -241,6 +273,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     body = _reduce_fn(op, g.axis_name)
     if _in_spmd(g.axis_name):
         return _ret(tensor, body(_unwrap(tensor)))
+    x = _unwrap(tensor)
+    if _mp_eager(g, x):
+        from jax.experimental import multihost_utils as mhu
+
+        stacked = mhu.process_allgather(x, tiled=False)  # [nproc, ...]
+        return _ret(tensor, _mp_axis_reduce(op, jnp.asarray(stacked)))
     # eager: shards go in per-rank, reduced value comes out replicated
     val = _apply(tensor, g, body, in_specs=P(g.axis_name), out_specs=P(g.axis_name))
     # result is identical on every shard slice; collapse back to the
@@ -267,6 +305,14 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
             tensor_list.extend(Tensor(p) for p in parts)
             return tensor_list
         return Tensor(out)
+    if _mp_eager(g, x):
+        from jax.experimental import multihost_utils as mhu
+
+        stacked = jnp.asarray(mhu.process_allgather(x, tiled=False))
+        if tensor_list is not None:
+            tensor_list.extend(Tensor(stacked[i]) for i in range(g.nranks))
+            return tensor_list
+        return Tensor(stacked.reshape((-1,) + tuple(stacked.shape[2:])))
     # eager sharded-array model: the global array already IS the
     # concatenation of per-rank shards, so the gather is an identity on
     # values; per-rank pieces are the dim0 chunks.
@@ -314,6 +360,14 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
     if _in_spmd(g.axis_name):
         return _ret(tensor, per_shard(_unwrap(tensor)))
+    xv = _unwrap(tensor)
+    if _mp_eager(g, xv):
+        import jax as _jax
+        from jax.experimental import multihost_utils as mhu
+
+        val = mhu.broadcast_one_to_all(
+            xv, is_source=_jax.process_index() == src)
+        return _ret(tensor, jnp.asarray(val))
     return _ret(tensor, _apply(tensor, g, per_shard))
 
 
@@ -552,6 +606,18 @@ def barrier(group=None):
     g = group or _default_group()
     if _in_spmd(g.axis_name):
         lax.psum(jnp.ones(()), g.axis_name)
+        return
+    import jax as _jax
+
+    if _jax.process_count() > 1:
+        if g.nranks != _jax.process_count():
+            raise NotImplementedError(
+                "multi-controller barrier on a subgroup is not supported "
+                "(sync_global_devices is global); barrier on the default "
+                "group instead")
+        from jax.experimental import multihost_utils as mhu
+
+        mhu.sync_global_devices("paddle_tpu.distributed.barrier")
         return
     t = Tensor(jnp.ones((g.nranks,)))
     all_reduce(t, group=g)

@@ -1,0 +1,97 @@
+"""SelectedRows: row-sparse tensor for embedding-style gradients.
+
+Reference: ``paddle/phi/core/selected_rows.h`` — a (rows, value, height)
+triple the reference uses for ``Embedding(sparse=True)`` gradients and PS
+sparse tables, so a lookup over a few thousand ids out of a 50k-row table
+never materializes the dense [height, dim] gradient.
+
+TPU-native role: the backward of a sparse-enabled embedding produces a
+:class:`SelectedRows` (rows = the looked-up ids, values = the output
+cotangent rows); optimizers with a sparse fast path (SGD) apply it as a
+scatter-add without densifying, everything else reads ``.to_dense()``
+through the wrapping grad Tensor. Under jit, rows/values are traced arrays
+and the scatter compiles into the step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "SparseGradTensor"]
+
+
+class SelectedRows:
+    def __init__(self, rows, values, height):
+        self.rows = rows          # int32 [n]
+        self.values = values      # [n, dim...]
+        self.height = int(height)
+
+    def merge_rows(self):
+        """Unique rows with summed values (reference
+        ``operators/math/selected_rows_functor.cc MergeAdd``). Keeps the
+        static shape (XLA-friendly): uniques via sort+segment rather than a
+        data-dependent compaction — duplicate slots become zero rows
+        pointing at row 0 with zero value."""
+        order = jnp.argsort(self.rows)
+        r = self.rows[order]
+        v = self.values[order]
+        first = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+        # compact: slot i<k holds the sum for the i-th unique row; slots
+        # beyond k stay (row 0, zero value) — harmless for scatter-add
+        out_v = jnp.zeros_like(v).at[seg].add(v)
+        out_r = jnp.zeros_like(r).at[seg].max(r)
+        return SelectedRows(out_r, out_v, self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                          self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def append(self, other: "SelectedRows"):
+        return SelectedRows(
+            jnp.concatenate([self.rows, other.rows]),
+            jnp.concatenate([self.values, other.values]),
+            self.height,
+        )
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"dim={tuple(self.values.shape[1:])})")
+
+
+class SparseGradTensor(Tensor):
+    """A Tensor-compatible view of a SelectedRows gradient: consumers that
+    read ``._value``/``.numpy()`` get the dense equivalence (computed once,
+    cached); sparse-aware optimizers read ``.selected_rows`` directly."""
+
+    def __init__(self, sr: SelectedRows):
+        self._sr = sr
+        super().__init__(jnp.zeros((0,), sr.values.dtype), stop_gradient=True)
+        # base __init__ wrote a placeholder through the property setter —
+        # drop it so the first real read densifies the SelectedRows
+        self._dense_cache = None
+
+    @property
+    def selected_rows(self):
+        return self._sr
+
+    @property
+    def _value(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._sr.to_dense()
+        return self._dense_cache
+
+    @_value.setter
+    def _value(self, v):
+        # dense writes (e.g. grad clip rescale) demote to a plain dense cache
+        self._dense_cache = v
+
+    def accumulate(self, other):
+        if isinstance(other, SelectedRows):
+            self._sr = self._sr.append(other)
+            self._dense_cache = None
+        else:
+            self._dense_cache = self._value + other

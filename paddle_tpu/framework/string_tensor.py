@@ -1,0 +1,66 @@
+"""StringTensor + string kernels.
+
+Reference: ``paddle/phi/core/string_tensor.h`` and
+``phi/kernels/strings/`` (case-conversion kernels backing the
+faster_tokenizer op family). Strings are host-side data — no accelerator
+ever sees them — so the TPU-native representation is a numpy object array
+with vectorized kernels; the tensor carries shape/indexing semantics so
+tokenizer-style pipelines can treat it like the other tensor types.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "strings_lower", "strings_upper"]
+
+
+class StringTensor:
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-D StringTensor")
+        return self._data.shape[0]
+
+    def __eq__(self, other):
+        o = other._data if isinstance(other, StringTensor) else other
+        return self._data == o
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"
+
+
+def _case_kernel(fn):
+    def kernel(x, use_utf8_encoding=True, name=None):
+        data = x._data if isinstance(x, StringTensor) else np.asarray(x, object)
+        out = np.frompyfunc(fn, 1, 1)(data)
+        return StringTensor(out)
+
+    return kernel
+
+
+strings_lower = _case_kernel(lambda s: s.lower())
+strings_upper = _case_kernel(lambda s: s.upper())

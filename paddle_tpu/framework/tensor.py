@@ -171,6 +171,18 @@ class Tensor:
         return _Handle()
 
     def _accumulate_grad(self, cot):
+        from .selected_rows import SelectedRows, SparseGradTensor
+
+        if isinstance(cot, SelectedRows):
+            # Embedding(sparse=True): keep the row-sparse form; dense
+            # consumers densify lazily through SparseGradTensor._value
+            if self._grad is None:
+                self._grad = SparseGradTensor(cot)
+            elif isinstance(self._grad, SparseGradTensor):
+                self._grad.accumulate(cot)
+            else:
+                self._grad._value = self._grad._value + cot.to_dense()
+            return
         if cot.dtype != self._value.dtype:
             cot = cot.astype(self._value.dtype)
         # ZeRO stage-2: grads are sharded AT PRODUCTION over the sharding

@@ -7,4 +7,4 @@ from . import autotune  # noqa: F401
 from . import asp  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import multiprocessing  # noqa: F401
-from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401

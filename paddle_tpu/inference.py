@@ -75,14 +75,21 @@ class _Handle:
 
 class Predictor:
     def __init__(self, config: Config):
+        import os
+        import pickle
+
         from .jit import load as jit_load
 
         if config.model_dir() is None:
             raise ValueError("Config has no model path; call set_model()")
-        self._layer = jit_load(config.model_dir())
-        n_in = len(getattr(self._layer, "_input_names", []) or []) or 1
-        self._in_names = (list(getattr(self._layer, "_input_names", []))
-                          or [f"input_{i}" for i in range(n_in)])
+        path = config.model_dir()
+        self._layer = jit_load(path)
+        n_in = 1
+        meta_path = path + ".pdmeta"
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as f:
+                n_in = int(pickle.load(f).get("n_inputs", 1))
+        self._in_names = [f"input_{i}" for i in range(n_in)]
         self._inputs = {n: _Handle() for n in self._in_names}
         self._outputs = []
 

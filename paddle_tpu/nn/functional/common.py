@@ -123,7 +123,49 @@ def _embedding_raw(weight, ids, padding_idx=None):
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     # the int32 cast happens INSIDE the recorded op so static Variables
     # stay symbolic (no eager ._value access at record time)
+    if sparse:
+        out = _embedding_sparse(x, weight, padding_idx)
+        if out is not None:
+            return out
     return _embedding_raw(weight, x, padding_idx=padding_idx)
+
+
+def _embedding_sparse(x, weight, padding_idx):
+    """Row-sparse gradient path (reference ``Embedding(sparse=True)`` →
+    SelectedRows grad, ``phi/core/selected_rows.h``): the backward emits a
+    (rows=ids, values=cotangent) SelectedRows instead of a dense scatter
+    onto the whole table. Eager leaf-weight path only; static recording or
+    a non-leaf weight falls back to the dense op (returns None)."""
+    from ...autograd.engine import GradNode, is_grad_enabled, leaf_edge
+    from ...framework.selected_rows import SelectedRows
+    from ...ops import dispatch
+
+    if dispatch.STATIC_RECORDER is not None or not is_grad_enabled():
+        return None
+    if weight.stop_gradient or weight._grad_node is not None:
+        return None
+    ids = x._value.astype(jnp.int32)
+    w = weight._value
+    out_val = jnp.take(w, ids, axis=0)
+    pi = None
+    if padding_idx is not None:
+        pi = padding_idx if padding_idx >= 0 else padding_idx + w.shape[0]
+        out_val = out_val * (ids != pi)[..., None].astype(out_val.dtype)
+    height, dim = w.shape[0], w.shape[1]
+    flat_ids = ids.reshape(-1)
+
+    def vjp_fn(cot):
+        vals = cot.reshape(-1, dim)
+        if pi is not None:
+            vals = vals * (flat_ids != pi)[:, None].astype(vals.dtype)
+        return (SelectedRows(flat_ids, vals, height),)
+
+    node = GradNode("embedding_sparse", vjp_fn, [leaf_edge(weight)],
+                    [(out_val.shape, out_val.dtype)], multi=False)
+    out = Tensor(out_val, stop_gradient=False)
+    out._grad_node = node
+    out._out_slot = 0
+    return out
 
 
 def one_hot(x, num_classes, name=None):

@@ -72,6 +72,7 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        self._sparse = bool(sparse)
         self._padding_idx = (
             None
             if padding_idx is None
@@ -88,7 +89,8 @@ class Embedding(Layer):
             self.weight._value = self.weight._value.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

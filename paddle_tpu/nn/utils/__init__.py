@@ -1,0 +1,8 @@
+"""paddle.nn.utils (reference ``python/paddle/nn/utils/``: weight_norm /
+spectral_norm reparameterization hooks + parameter<->vector transforms)."""
+from .weight_norm_hook import remove_weight_norm, weight_norm  # noqa: F401
+from .spectral_norm_hook import spectral_norm  # noqa: F401
+from .transform_parameters import (  # noqa: F401
+    parameters_to_vector,
+    vector_to_parameters,
+)

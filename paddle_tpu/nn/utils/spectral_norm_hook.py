@@ -1,0 +1,82 @@
+"""Spectral normalization hook (reference
+``nn/utils/spectral_norm_hook.py``): ``w = w_orig / sigma(w)`` with sigma
+estimated by power iteration, updated each forward.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Parameter, Tensor
+
+__all__ = ["spectral_norm"]
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def _reshape(self, w):
+        if self.dim != 0:
+            perm = [self.dim] + [i for i in range(w.ndim) if i != self.dim]
+            w = jnp.transpose(w, perm)
+        return w.reshape(w.shape[0], -1)
+
+    def __call__(self, layer, inputs):
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        from ...ops.dispatch import apply_op
+
+        n, eps, dim = self.n, self.eps, self.dim
+        reshape = self._reshape
+
+        def fwd(w_val, u_val):
+            wm = reshape(w_val.astype(jnp.float32))
+            uu = u_val.astype(jnp.float32)
+            vv = None
+            for _ in range(max(n, 1)):
+                vv = wm.T @ uu
+                vv = vv / jnp.maximum(jnp.linalg.norm(vv), eps)
+                uu = wm @ vv
+                uu = uu / jnp.maximum(jnp.linalg.norm(uu), eps)
+            sigma = uu @ wm @ vv
+            return (w_val.astype(jnp.float32) / sigma).astype(w_val.dtype), uu
+
+        out = apply_op("spectral_norm_hook", fwd, (w, u), {})
+        w_n, u_new = out
+        tgt = getattr(layer, self.name)
+        tgt._value = w_n._value
+        tgt._grad_node = w_n._grad_node
+        tgt._out_slot = w_n._out_slot
+        u._value = u_new._value  # power-iteration state (no grad)
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    if hasattr(layer, name + "_orig"):
+        raise ValueError(f"spectral_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 1 if type(layer).__name__ in ("Linear",) else 0
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(w._value.shape[dim]).astype(np.float32)
+    u0 /= max(np.linalg.norm(u0), eps)
+
+    orig = Parameter(jnp.asarray(w._value))
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+    derived = Parameter(jnp.asarray(w._value))
+    object.__setattr__(layer, name, derived)
+    u = Tensor(jnp.asarray(u0))
+    u.stop_gradient = True
+    layer.register_buffer(name + "_u", u)
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_hooks = getattr(layer, "_spectral_norm_hooks", {})
+    layer._spectral_norm_hooks[name] = (hook, handle)
+    hook(layer, ())
+    return layer

@@ -1,0 +1,31 @@
+"""Parameter <-> flat-vector transforms (reference
+``nn/utils/transform_parameters.py:74,121``); used by L-BFGS-style
+optimizers and parameter averaging."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters"]
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    if not vals:
+        raise ValueError("parameters_to_vector got an empty parameter list")
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    total = sum(int(jnp.size(p._value)) for p in parameters)
+    if int(v.size) != total:
+        raise ValueError(
+            f"vector has {int(v.size)} elements but parameters need {total}")
+    for p in parameters:
+        n = int(jnp.size(p._value))
+        p._value = v[offset:offset + n].reshape(p._value.shape).astype(
+            p._value.dtype)
+        offset += n

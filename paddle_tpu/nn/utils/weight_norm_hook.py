@@ -1,0 +1,102 @@
+"""Weight normalization reparameterization (reference
+``nn/utils/weight_norm_hook.py``): ``w = g * v / ||v||`` with ``g``/``v``
+trainable and ``w`` recomputed by a forward pre-hook each call.
+
+TPU-native note: the recompute is a tiny normalized-scale expression XLA
+fuses into the consuming matmul; under CompiledStep the hook runs inside
+the trace so the reparameterization compiles into the step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+def _compute_weight(g, v, dim):
+    vv = v._value.astype(jnp.float32)
+    norm = _norm_except_dim(vv, dim)
+    w = (g._value.astype(jnp.float32) * vv / jnp.maximum(norm, 1e-12))
+    return w.astype(v._value.dtype)
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        w = getattr(layer, self.name)
+        # recompute w = g * v/||v|| as a recorded op so gradients flow to
+        # (g, v) through whatever consumes w this forward
+        from ...ops.dispatch import apply_op
+
+        dim = self.dim
+        out = apply_op("weight_norm_recompute",
+                       lambda gv, vv: _compute_weight_raw(gv, vv, dim),
+                       (g, v), {})
+        w._value = out._value
+        w._grad_node = out._grad_node
+        w._out_slot = out._out_slot
+        return None
+
+
+def _compute_weight_raw(g, v, dim):
+    vv = v.astype(jnp.float32)
+    norm = _norm_except_dim(vv, dim)
+    return (g.astype(jnp.float32) * vv / jnp.maximum(norm, 1e-12)).astype(v.dtype)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Replace ``layer.<name>`` with the (g, v) parameterization."""
+    if hasattr(layer, name + "_g"):
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    dim_ = dim if dim is not None else None
+    vv = w._value
+    norm = _norm_except_dim(vv.astype(jnp.float32), dim_)
+    g = Parameter(jnp.asarray(norm, jnp.float32))
+    v = Parameter(jnp.asarray(vv))
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # demote the original weight to a derived (non-trainable-leaf) tensor:
+    # it stays an attribute so forward() code is unchanged, but the
+    # parameter list exposes only g and v
+    del layer._parameters[name]
+    derived = Parameter(jnp.asarray(vv))
+    derived.stop_gradient = False
+    object.__setattr__(layer, name, derived)
+    hook = _WeightNormHook(name, dim_)
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    # initialize w once so inference-before-first-forward also works
+    hook(layer, ())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    hook, handle = hooks.pop(name)
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = Parameter(jnp.asarray(_compute_weight(g, v, hook.dim)))
+    handle.remove() if hasattr(handle, "remove") else None
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    delattr(layer, name + "_g") if hasattr(type(layer), name + "_g") else None
+    layer.add_parameter(name, w)
+    return layer

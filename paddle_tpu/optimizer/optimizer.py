@@ -188,8 +188,21 @@ class Optimizer:
         if self._grad_clip is not None:
             pgs = self._grad_clip(pgs)
         lr = self._lr_array()
+        from ..framework.selected_rows import SparseGradTensor
+
         for p, g in pgs:
             if g is None:
+                continue
+            if (isinstance(g, SparseGradTensor) and g._dense_cache is None
+                    and hasattr(self, "_sparse_update")
+                    and self._weight_decay is None
+                    and getattr(p, "regularizer", None) is None
+                    and not self._uses_master(p)):
+                # row-sparse fast path (reference sparse-kernel optimizer
+                # ops over SelectedRows): only the touched rows update
+                param_lr = getattr(p, "optimize_attr", {}).get(
+                    "learning_rate", 1.0)
+                self._sparse_update(p, g.selected_rows, lr * param_lr)
                 continue
             gv = g._value if isinstance(g, Tensor) else g
             # plain leaf Tensors (stop_gradient=False) are optimizable like

@@ -25,6 +25,13 @@ class SGD(Optimizer):
     def _update_param(self, p, grad, lr):
         return p._value - lr * grad
 
+    def _sparse_update(self, p, sr, lr):
+        """SelectedRows grad: scatter-subtract onto the touched rows only
+        (reference sgd_op's SelectedRows kernel)."""
+        merged = sr.merge_rows()
+        p._value = p._value.at[merged.rows].add(
+            (-lr * merged.values).astype(p._value.dtype))
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, multi_precision=False, name=None):

@@ -86,6 +86,8 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     ``[..., n_fft//2+1 | n_fft, num_frames]`` complex."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError(f"win_length ({win_length}) > n_fft ({n_fft})")
     if window is not None:
         w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
         if w.shape[0] != win_length:
@@ -93,9 +95,9 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
                 f"window length {w.shape[0]} != win_length {win_length}")
     else:
         w = jnp.ones((win_length,), jnp.float32)
-    pad = (n_fft - win_length) // 2
-    if pad:
-        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    if win_length != n_fft:
+        left = (n_fft - win_length) // 2
+        w = jnp.pad(w, (left, n_fft - win_length - left))
 
     is_complex = "complex" in str(x.dtype)
     if is_complex and onesided:
@@ -128,13 +130,15 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     (reference ``signal.py:395``). x: ``[..., n_bins, num_frames]``."""
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise ValueError(f"win_length ({win_length}) > n_fft ({n_fft})")
     if window is not None:
         w = window._value if isinstance(window, Tensor) else jnp.asarray(window)
     else:
         w = jnp.ones((win_length,), jnp.float32)
-    pad = (n_fft - win_length) // 2
-    if pad:
-        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    if win_length != n_fft:
+        left = (n_fft - win_length) // 2
+        w = jnp.pad(w, (left, n_fft - win_length - left))
 
     def fwd(a, wv):
         if onesided:

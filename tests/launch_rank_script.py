@@ -71,3 +71,21 @@ with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
     json.dump({"rank": rank, "world": world, "psum": got,
                "loss": loss_val, "w": w_after}, f)
 print(f"rank {rank} OK", flush=True)
+
+# 3. reference per-rank eager collective semantics (multihost_utils path):
+# each process contributes its LOCAL value — NCCL-style, not stacked-global
+lr = Tensor(np.full((2,), float(rank + 1), np.float32))
+summed = dist.all_reduce(lr)
+assert np.allclose(np.asarray(summed._value), 3.0), np.asarray(summed._value)
+
+gathered = []
+dist.all_gather(gathered, Tensor(np.full((2,), float(rank), np.float32)))
+assert len(gathered) == 2
+assert np.allclose(np.asarray(gathered[0]._value), 0.0)
+assert np.allclose(np.asarray(gathered[1]._value), 1.0)
+
+b = Tensor(np.full((3,), float(rank * 7 + 1), np.float32))
+bc = dist.broadcast(b, src=1)
+assert np.allclose(np.asarray(bc._value), 8.0), np.asarray(bc._value)
+
+dist.barrier()

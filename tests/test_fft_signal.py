@@ -107,6 +107,23 @@ def test_stft_matches_manual_dft():
                                atol=1e-3)
 
 
+def test_stft_window_padding_odd_win_length():
+    """win_length one less than n_fft must center-pad the window (the
+    `(n_fft-w)//2 == 0` case) and win_length > n_fft must raise."""
+    x = rng.randn(256).astype(np.float32)
+    win = np.hanning(63).astype(np.float32)
+    spec = paddle.signal.stft(t(x), 64, hop_length=16, win_length=63,
+                              window=t(win), center=False)
+    assert spec.shape[0] == 33  # n_fft//2 + 1 — padded window applied cleanly
+    back = paddle.signal.istft(spec, 64, hop_length=16, win_length=63,
+                               window=t(win), center=False)
+    assert np.isfinite(back.numpy()).all()
+    with pytest.raises(ValueError, match="win_length"):
+        paddle.signal.stft(t(x), 64, win_length=65)
+    with pytest.raises(ValueError, match="win_length"):
+        paddle.signal.istft(spec, 64, win_length=65)
+
+
 def test_stft_istft_roundtrip():
     x = rng.randn(512).astype(np.float32)
     n_fft, hop = 128, 32

@@ -128,6 +128,38 @@ def test_tensor_namespace():
     assert T.concat is not None and T.linalg is not None
 
 
+def test_inference_predictor_two_inputs(tmp_path):
+    """Predictor must expose one handle per saved input (n_inputs from the
+    .pdmeta written at save time)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import save
+
+    class TwoIn(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, a, b):
+            return self.fc(a) + self.fc(b)
+
+    paddle.seed(1)
+    net = TwoIn()
+    a = paddle.to_tensor(np.ones((2, 4), np.float32))
+    b = paddle.to_tensor(np.full((2, 4), 2.0, np.float32))
+    ref = net(a, b).numpy()
+    path = str(tmp_path / "two_in")
+    save(net, path, input_spec=[paddle.static.InputSpec([2, 4], "float32"),
+                                paddle.static.InputSpec([2, 4], "float32")])
+    pred = paddle.inference.create_predictor(paddle.inference.Config(path))
+    names = pred.get_input_names()
+    assert names == ["input_0", "input_1"]
+    pred.get_input_handle("input_0").copy_from_cpu(a.numpy())
+    pred.get_input_handle("input_1").copy_from_cpu(b.numpy())
+    pred.run()
+    np.testing.assert_allclose(
+        pred.get_output_handle("output_0").copy_to_cpu(), ref, rtol=1e-5)
+
+
 def test_inference_predictor_roundtrip(tmp_path):
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import save

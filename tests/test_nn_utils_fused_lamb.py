@@ -1,0 +1,130 @@
+"""nn.utils (weight_norm / spectral_norm / parameter transforms),
+DistributedFusedLamb, and the tape-vs-functional grad cross-check.
+
+Reference: ``nn/utils/weight_norm_hook.py`` (w = g·v/‖v‖ with grads to g,v),
+``spectral_norm_hook.py`` (power iteration), ``transform_parameters.py``;
+``incubate/optimizer/distributed_fused_lamb.py``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_weight_norm_reparameterizes_and_trains():
+    paddle.seed(0)
+    lin = nn.Linear(4, 3)
+    w0 = lin.weight.numpy().copy()
+    nn.utils.weight_norm(lin, "weight", dim=0)
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight_g" in names and "weight_v" in names
+    assert "weight" not in names  # the derived tensor is not a leaf param
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    # forward recomputes w from (g, v): initially identical to original w
+    y = lin(x)
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5, atol=1e-6)
+    # grads flow to g and v, not to the derived weight
+    loss = (y ** 2).mean()
+    loss.backward()
+    g = lin.weight_g
+    v = lin.weight_v
+    assert g.grad is not None and v.grad is not None
+    # training moves (g, v) and therefore the effective weight
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    opt.step()
+    opt.clear_grad()
+    y2 = lin(x)
+    assert not np.allclose(lin.weight.numpy(), w0)
+    assert not np.allclose(y2.numpy(), y.numpy())
+
+
+def test_remove_weight_norm_restores_plain_param():
+    paddle.seed(1)
+    lin = nn.Linear(4, 3)
+    nn.utils.weight_norm(lin, "weight")
+    x = paddle.to_tensor(np.ones((1, 4), np.float32))
+    ref = lin(x).numpy()
+    nn.utils.remove_weight_norm(lin, "weight")
+    names = [n for n, _ in lin.named_parameters()]
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin(x).numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_bounds_singular_value():
+    paddle.seed(2)
+    lin = nn.Linear(6, 8)
+    # inflate the weight so sigma >> 1
+    lin.weight.set_value(paddle.to_tensor(
+        np.random.RandomState(3).randn(6, 8).astype(np.float32) * 5.0))
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+    x = paddle.to_tensor(np.random.RandomState(4).randn(2, 6).astype(np.float32))
+    lin(x)  # hook refreshes w
+    sigma = np.linalg.svd(lin.weight.numpy(), compute_uv=False).max()
+    assert sigma == pytest.approx(1.0, rel=1e-2)
+    # gradient flows to the orig parameterization
+    (lin(x) ** 2).mean().backward()
+    assert lin.weight_orig.grad is not None
+
+
+def test_parameters_to_vector_roundtrip():
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(3, 4), nn.Linear(4, 2))
+    params = net.parameters()
+    vec = nn.utils.parameters_to_vector(params)
+    total = sum(int(np.prod(p.shape)) for p in params)
+    assert list(vec.shape) == [total]
+    new_vec = paddle.to_tensor(np.arange(total, dtype=np.float32))
+    nn.utils.vector_to_parameters(new_vec, params)
+    back = nn.utils.parameters_to_vector(params)
+    np.testing.assert_allclose(back.numpy(), new_vec.numpy())
+    with pytest.raises(ValueError, match="elements"):
+        nn.utils.vector_to_parameters(
+            paddle.to_tensor(np.zeros(3, np.float32)), params)
+
+
+def test_distributed_fused_lamb_matches_lamb_single_process():
+    rng = np.random.RandomState(6)
+    xs = [paddle.to_tensor(rng.randn(8, 4).astype(np.float32)) for _ in range(4)]
+
+    def build(cls, **kw):
+        paddle.seed(7)
+        net = nn.Linear(4, 3)
+        opt = cls(learning_rate=0.01, lamb_weight_decay=0.01,
+                  parameters=net.parameters(), **kw)
+        return net, opt
+
+    net_a, opt_a = build(paddle.incubate.DistributedFusedLamb)
+    net_b, opt_b = build(paddle.optimizer.Lamb)
+    for x in xs:
+        (net_a(x) ** 2).mean().backward()
+        opt_a.step()
+        opt_a.clear_grad()
+        (net_b(x) ** 2).mean().backward()
+        opt_b.step()
+        opt_b.clear_grad()
+    np.testing.assert_allclose(net_a.weight.numpy(), net_b.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tape_and_functional_grad_agree():
+    """Round-2 review item: paddle.grad (tape) and incubate.autograd.grad
+    (functional jax) must agree on shared cases."""
+    import paddle_tpu.incubate.autograd as iag
+
+    rng = np.random.RandomState(8)
+    xv = rng.randn(5).astype(np.float32)
+
+    def f_tensor(x):
+        return (x ** 3 + 2.0 * x).sum()
+
+    # tape path
+    x1 = paddle.to_tensor(xv, stop_gradient=False)
+    (g_tape,) = paddle.grad(f_tensor(x1), [x1])
+    # functional path
+    x2 = paddle.to_tensor(xv)
+    g_fn = iag.grad(f_tensor, x2)
+    g_fn = g_fn[0] if isinstance(g_fn, (list, tuple)) else g_fn
+    np.testing.assert_allclose(g_tape.numpy(), g_fn.numpy(), rtol=1e-5)
+    # analytic: 3x^2 + 2
+    np.testing.assert_allclose(g_tape.numpy(), 3 * xv ** 2 + 2, rtol=1e-4)

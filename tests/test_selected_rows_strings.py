@@ -1,0 +1,124 @@
+"""SelectedRows sparse embedding gradients + StringTensor.
+
+Reference: ``phi/core/selected_rows.h`` (Embedding(sparse=True) grads),
+``operators/math/selected_rows_functor.cc`` (MergeAdd),
+``phi/core/string_tensor.h`` + ``phi/kernels/strings/``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.selected_rows import SelectedRows, SparseGradTensor
+from paddle_tpu.framework.string_tensor import (
+    StringTensor,
+    strings_lower,
+    strings_upper,
+)
+
+
+def test_sparse_embedding_grad_is_selected_rows():
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 5, 5], [7, 1, 3]], np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SparseGradTensor)
+    sr = g.selected_rows
+    assert sr.height == 100 and sr.values.shape == (6, 8)
+    # dense equivalence matches the dense embedding's gradient
+    paddle.seed(0)
+    emb_d = nn.Embedding(100, 8, sparse=False)
+    out_d = emb_d(ids)
+    out_d.sum().backward()
+    np.testing.assert_allclose(np.asarray(g._value),
+                               emb_d.weight.grad.numpy(), rtol=1e-6)
+
+
+def test_sparse_sgd_updates_only_touched_rows():
+    paddle.seed(1)
+    emb = nn.Embedding(50, 4, sparse=True)
+    w0 = emb.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(0.5, parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([2, 7, 7, 11], np.int64))
+    (emb(ids) ** 2).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    w1 = emb.weight.numpy()
+    touched = {2, 7, 11}
+    for r in range(50):
+        if r in touched:
+            assert not np.allclose(w1[r], w0[r]), r
+        else:
+            np.testing.assert_array_equal(w1[r], w0[r])
+
+
+def test_sparse_matches_dense_training():
+    """Sparse and dense embeddings must follow the same SGD trajectory."""
+    ids_batches = [np.array([3, 9, 9, 40], np.int64),
+                   np.array([0, 3, 17, 9], np.int64)]
+
+    def run(sparse):
+        paddle.seed(2)
+        emb = nn.Embedding(64, 4, sparse=sparse)
+        opt = paddle.optimizer.SGD(0.1, parameters=emb.parameters())
+        for ids in ids_batches:
+            (emb(paddle.to_tensor(ids)) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+        return emb.weight.numpy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_padding_idx_rows_frozen():
+    paddle.seed(3)
+    emb = nn.Embedding(20, 4, sparse=True, padding_idx=0)
+    opt = paddle.optimizer.SGD(0.5, parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([0, 1, 0, 2], np.int64))
+    (emb(ids) ** 2).sum().backward()
+    opt.step()
+    np.testing.assert_array_equal(emb.weight.numpy()[0], np.zeros(4))
+
+
+def test_selected_rows_merge_and_dense():
+    import jax.numpy as jnp
+
+    sr = SelectedRows(jnp.asarray([3, 1, 3], jnp.int32),
+                      jnp.asarray([[1.0], [2.0], [10.0]]), height=5)
+    merged = sr.merge_rows()
+    dense = np.asarray(merged.to_dense()).reshape(-1)
+    np.testing.assert_allclose(dense, [0, 2, 0, 11, 0])
+    np.testing.assert_allclose(np.asarray(sr.to_dense()).reshape(-1),
+                               [0, 2, 0, 11, 0])
+
+
+def test_adam_densifies_sparse_grad():
+    """Optimizers without a sparse kernel consume the dense equivalence
+    (reference: non-sparse-supporting ops densify SelectedRows)."""
+    paddle.seed(4)
+    emb = nn.Embedding(30, 4, sparse=True)
+    opt = paddle.optimizer.Adam(0.1, parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([5, 6], np.int64))
+    (emb(ids) ** 2).sum().backward()
+    opt.step()  # must not raise; trajectory equals dense Adam
+    paddle.seed(4)
+    emb_d = nn.Embedding(30, 4, sparse=False)
+    opt_d = paddle.optimizer.Adam(0.1, parameters=emb_d.parameters())
+    (emb_d(paddle.to_tensor(np.array([5, 6], np.int64))) ** 2).sum().backward()
+    opt_d.step()
+    np.testing.assert_allclose(emb.weight.numpy(), emb_d.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_string_tensor_kernels():
+    st = StringTensor([["Hello", "WORLD"], ["PaddlePaddle", "TPU"]])
+    assert st.shape == [2, 2]
+    low = strings_lower(st)
+    up = strings_upper(st)
+    assert low.tolist() == [["hello", "world"], ["paddlepaddle", "tpu"]]
+    assert up.tolist() == [["HELLO", "WORLD"], ["PADDLEPADDLE", "TPU"]]
+    assert st[0, 0] == "Hello"
+    assert len(st) == 2
+    assert (st == st).all()
