@@ -406,7 +406,19 @@ def take_along_axis(arr, indices, axis, broadcast=True):
 
 
 @op("put_along_axis")
-def _put_along_axis_raw(x, indices, values, axis=0, reduce="assign", include_self=True):
+def _put_along_axis_raw(x, indices, values, axis=0, reduce="assign",
+                        include_self=True, bshape=None):
+    if bshape is not None:
+        # index + values broadcasts happen INSIDE the recorded op so the
+        # caller's values tensor keeps its autograd link and static
+        # Variables stay symbolic (host-side broadcast_to on a fresh Tensor
+        # dropped the gradient; .reshape on a ShapeDtypeStruct raised)
+        if indices.ndim != x.ndim:
+            indices = indices.reshape(
+                [-1 if i == axis else 1 for i in range(x.ndim)])
+        indices = jnp.broadcast_to(indices, bshape)
+        values = (jnp.broadcast_to(values, bshape) if getattr(values, "ndim", 0)
+                  else jnp.full(bshape, values, x.dtype))
     if reduce == "assign":
         return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
     dn = jnp.zeros_like(x) if not include_self else x
@@ -428,14 +440,15 @@ def _scatter_add_along(zeros, indices, values, axis):
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True, broadcast=True):
     values = ensure_tensor(values, like=arr)
-    idx = indices._value
-    v = values._value
+    bshape = None
     if broadcast:
         tgt = list(arr.shape)
-        tgt[axis] = idx.shape[axis] if idx.ndim == arr.ndim else 1
-        idx = jnp.broadcast_to(idx.reshape(idx.shape if idx.ndim == arr.ndim else [-1 if i == axis else 1 for i in range(arr.ndim)]), tgt)
-        v = jnp.broadcast_to(v, tgt) if v.ndim else jnp.full(tgt, v, arr._value.dtype)
-    return _put_along_axis_raw(arr, Tensor(idx), Tensor(v), axis=axis, reduce=reduce, include_self=include_self)
+        idx_ndim = len(indices.shape)
+        tgt[axis] = indices.shape[axis] if idx_ndim == len(arr.shape) else 1
+        bshape = tuple(tgt)
+    return _put_along_axis_raw(arr, indices, values, axis=axis,
+                               reduce=reduce, include_self=include_self,
+                               bshape=bshape)
 
 
 @op("scatter")

@@ -209,3 +209,42 @@ _op("F.scaled_dot_product_attention",
     (((1, 4, 2, 4), "f"), ((1, 4, 2, 4), "f2"), ((1, 4, 2, 4), "f3")),
     kwargs=dict(training=False), rtol=2e-2)
 
+
+# --- round-3 widening: manipulation / search --------------------------------
+_op("ops.where", ((S, "bool"), (S, "f"), (S, "f2")), only=(1, 2))
+_op("ops.sort", ((V, "funique"),))
+_op("ops.topk", ((V, "funique"),), kwargs=dict(k=3))
+_op("ops.scatter", (((5, 3), "f"), ((2,), "int:5"), ((2, 3), "f2")),
+    only=(0, 2))
+_op("ops.put_along_axis", (((4, 3), "f"), ((2, 3), "int:4"), ((2, 3), "f2")),
+    kwargs=dict(axis=0), only=(0, 2))
+_op("ops.fill_diagonal_", ((S, "f"),), kwargs=dict(value=0.5))
+_op("ops.pad", ((S, "f"),), kwargs=dict(pad=[1, 1, 0, 2], mode="constant"))
+# as_complex is NOT swept: the FD harness scalarizes via a real cast that
+# discards the imaginary channel — it has a dedicated both-channel gradient
+# test in tests/test_op_grads.py instead
+
+# --- round-3 widening: math tails -------------------------------------------
+_op("ops.frac", ((S, "f"),))
+_op("ops.nan_to_num", ((S, "f"),))
+_op("ops.deg2rad", ((S, "f"),))
+_op("ops.rad2deg", ((S, "f"),))
+_op("ops.cov", (((3, 6), "f"),))
+_op("ops.dist", ((S, "fnz"), (S, "f2")), rtol=2e-2)
+
+# --- round-3 widening: linalg decompositions --------------------------------
+_op("ops.qr", (((3, 3), "spd"),), rtol=3e-2, atol=5e-3)
+_op("ops.eigh", (((3, 3), "spd"),), rtol=3e-2, atol=5e-3)
+_op("ops.cholesky_solve", (((3, 2), "f"), ((3, 3), "trilpd")), rtol=3e-2,
+    atol=5e-3)
+
+# --- round-3 widening: norms + functional tails ------------------------------
+_op("F.group_norm", (((2, 4, 3, 3), "f"),), kwargs=dict(num_groups=2),
+    rtol=2e-2)
+_op("F.instance_norm", (((2, 3, 4, 4), "f"),), rtol=2e-2)
+_op("F.batch_norm", (((2, 3, 4, 4), "f"), ((3,), "f2"), ((3,), "fp"),
+                     ((3,), "fp"), ((3,), "f3")),
+    kwargs=dict(training=False), only=(0, 3, 4), rtol=2e-2)
+_op("F.cosine_similarity", (((3, 4), "fnz"), ((3, 4), "f2")), rtol=2e-2)
+_op("F.fold", (((1, 8, 4), "f"),),
+    kwargs=dict(output_sizes=[4, 4], kernel_sizes=2, strides=2))

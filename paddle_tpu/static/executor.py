@@ -28,17 +28,17 @@ def _replay(program, env, upto=None):
     """Run the tape on concrete/traced arrays. ``env``: Variable name -> array."""
     for node in program.ops if upto is None else program.ops[:upto]:
         vals = []
-        for a in node.args:
-            if isinstance(a, Variable):
-                vals.append(env[a.name])
+        for a, nm in zip(node.args, node.arg_names):
+            if nm is not None:
+                vals.append(env[nm])
             elif isinstance(a, Tensor):
                 vals.append(a._value)
             else:
                 vals.append(a)
         out = node.fwd(*vals, **node.kwargs)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
-        for v, o in zip(node.outs, outs):
-            env[v.name] = o
+        for nm, o in zip(node.out_names, outs):
+            env[nm] = o
     return env
 
 
@@ -96,7 +96,7 @@ class Executor:
                 continue
             v = feed[k]
             got = tuple(v.shape) if hasattr(v, "shape") else np.shape(v)
-            decl = ph._declared_shape
+            decl = getattr(ph, "_feed_shape", None) or ph._declared_shape
             if len(got) != len(decl) or any(
                 d not in (None, -1) and int(d) != g
                 for d, g in zip(decl, got)

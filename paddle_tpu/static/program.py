@@ -48,14 +48,35 @@ class Variable(Tensor):
             "static.Executor to get values"
         )
 
+    def _rebind(self, other):
+        """Inplace-op helper in static mode: adopt the new variable's NAME
+        too, so a fetch of the python-side handle resolves to the op's
+        output binding in the scope (eager rebind only swaps value/meta)."""
+        super()._rebind(other)
+        if isinstance(other, Variable):
+            self.name = other.name
+            self._declared_shape = list(other._declared_shape)
+        return self
+
     def __repr__(self):
         return f"Variable(name={self.name!r}, shape={self.shape}, dtype={self._value.dtype})"
 
 
 class _OpNode:
-    __slots__ = ("op_name", "fwd", "args", "kwargs", "outs")
+    __slots__ = ("op_name", "fwd", "args", "kwargs", "outs", "arg_names",
+                 "out_names")
 
-    def __init__(self, op_name, fwd, args, kwargs, outs):
+    def __init__(self, op_name, fwd, args, kwargs, outs, arg_names=None,
+                 out_names=None):
+        # snapshot Variable binding names at RECORD time: inplace ops rebind
+        # the python-side Variable (args AND producer outs) to the inplace
+        # op's output name afterwards, and a lazy .name read at replay would
+        # then resolve this node's reads/writes to the post-op binding
+        self.arg_names = (arg_names if arg_names is not None else
+                          [a.name if isinstance(a, Variable) else None
+                           for a in args])
+        self.out_names = (out_names if out_names is not None else
+                          [o.name for o in outs])
         self.op_name = op_name
         self.fwd = fwd
         self.args = args      # mix of Variable / Tensor(Parameter) / consts
@@ -109,7 +130,8 @@ class Program:
                 return x
 
             p.ops = [
-                _OpNode(n.op_name, _infer_dropout, n.args, n.kwargs, n.outs)
+                _OpNode(n.op_name, _infer_dropout, n.args, n.kwargs, n.outs,
+                        arg_names=n.arg_names, out_names=n.out_names)
                 if n.op_name == "dropout" else n
                 for n in self.ops
             ]
@@ -213,5 +235,9 @@ def data(name, shape, dtype="float32", lod_level=0):
     """Declare a feed placeholder (reference ``paddle.static.data``)."""
     prog = default_main_program()
     v = Variable(name, shape, dtype, program=prog)
+    # the feed-validation shape is pinned at declaration: an inplace op may
+    # later rebind the live handle (new name/shape), but the FEED for this
+    # name still arrives in the declared shape
+    v._feed_shape = list(shape)
     prog.placeholders[name] = v
     return v

@@ -97,3 +97,20 @@ def test_forward_values_against_numpy():
     np.testing.assert_allclose(paddle.t(t).numpy(), x.T)
     v, i = paddle.topk(t, 2, axis=1)
     np.testing.assert_allclose(v.numpy(), np.sort(x, axis=1)[:, ::-1][:, :2], rtol=1e-5)
+
+
+def test_as_complex_gradient_both_channels():
+    """|as_complex(x)|^2 is real and depends on BOTH channels, so this
+    checks the full complex vjp (the FD sweep's real-cast scalarization
+    would silently ignore the imaginary part)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    xv = np.array([[1.0, 2.0], [3.0, -4.0]], np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    z = paddle.as_complex(x)
+    mag2 = (z.real() ** 2 + z.imag() ** 2).sum()
+    mag2.backward()
+    # d/dx sum(re^2 + im^2) = 2x for both channels
+    np.testing.assert_allclose(x.grad.numpy(), 2 * xv, rtol=1e-5)

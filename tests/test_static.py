@@ -213,3 +213,45 @@ def test_dynamic_batch_dim_retraces_correctly():
                     fetch_list=[y.name])
     finally:
         paddle.disable_static()
+
+
+def test_inplace_op_in_static_program_and_feed_shape():
+    """Inplace ops rebind the static handle without corrupting earlier
+    reads or the placeholder's feed validation (record-time name snapshots
+    + declaration-pinned feed shape)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        main, startup = paddle.static.Program(), paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [3, 3], "float32")
+            y = x * 2.0
+            paddle.fill_diagonal_(y, 9.0)
+            z = y + 1.0  # must read the POST-write binding
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            (zo,) = exe.run(main, feed={"x": np.ones((3, 3), np.float32)},
+                            fetch_list=[z])
+        expect = np.full((3, 3), 3.0)
+        np.fill_diagonal(expect, 10.0)
+        np.testing.assert_allclose(zo, expect)
+
+        # inplace op applied directly to the PLACEHOLDER: the feed for its
+        # name still validates against the data()-time declaration
+        main2, startup2 = paddle.static.Program(), paddle.static.Program()
+        with paddle.static.program_guard(main2, startup2):
+            a = paddle.static.data("a", [2, 3], "float32")
+            paddle.fill_diagonal_(a, 5.0)
+            out = a + 0.0
+            exe = paddle.static.Executor()
+            exe.run(startup2)
+            (ao,) = exe.run(main2, feed={"a": np.zeros((2, 3), np.float32)},
+                            fetch_list=[out])
+        expect2 = np.zeros((2, 3), np.float32)
+        np.fill_diagonal(expect2, 5.0)
+        np.testing.assert_allclose(ao, expect2)
+    finally:
+        paddle.disable_static()
