@@ -48,12 +48,15 @@ def apply_op(name, fwd, args, static_kwargs):
     ``args`` may mix Tensors, raw arrays and python scalars; only Tensor args
     participate in autograd.
     """
+    if AMP_HOOK is not None:
+        # applied BEFORE recording so static Programs capture the autocast
+        # wrapper too (reference static AMP rewrites the program with cast
+        # ops — here the recorded fwd simply IS the autocasting fn)
+        fwd = AMP_HOOK(name, fwd)
     if STATIC_RECORDER is not None:
         recorded = STATIC_RECORDER(name, fwd, args, static_kwargs)
         if recorded is not None:
             return recorded
-    if AMP_HOOK is not None:
-        fwd = AMP_HOOK(name, fwd)
     vals = []
     tensor_pos = []
     for i, a in enumerate(args):

@@ -22,7 +22,20 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: the eager path compiles one executable per
-# (op, shape) — cache them across tests and across pytest runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_pt_cache")
+# (op, shape) — cache them across tests and across pytest runs. The dir is
+# keyed by the host CPU's feature set: this box's pool mixes machine types,
+# and XLA:CPU AOT executables cached by a host with (e.g.) prefer-no-scatter
+# SIGABRT when loaded on one without it (seen as cpu_aot_loader "machine
+# type doesn't match" errors followed by a fatal Abort mid-suite).
+import hashlib
+
+try:
+    _cpuinfo = open("/proc/cpuinfo").read()
+    _flags_line = next((l for l in _cpuinfo.splitlines()
+                        if l.startswith("flags")), "")
+    _cpu_key = hashlib.sha1(_flags_line.encode()).hexdigest()[:12]
+except OSError:
+    _cpu_key = "generic"
+jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_pt_cache_{_cpu_key}")
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -157,3 +157,38 @@ def test_prepare_type_errors():
         model.prepare(None, loss=123)
     with pytest.raises(RuntimeError):
         model.train_batch([np.zeros((2, 8), np.float32)], [np.zeros(2, np.int64)])
+
+
+def test_model_fit_under_data_parallel_mesh():
+    """Reference ``python/paddle/tests/dist_hapi_mnist_dynamic.py``: hapi
+    Model.fit with the net wrapped for data parallelism — here on the
+    8-device CPU mesh with batch sharding."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.io import Dataset
+
+    class Ds(Dataset):
+        def __init__(self, n=64):
+            r = np.random.RandomState(0)
+            self.x = r.randn(n, 8).astype(np.float32)
+            self.y = (self.x.sum(1) > 0).astype(np.int64)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    dp_net = paddle.DataParallel(net) if hasattr(paddle, "DataParallel") \
+        else paddle.distributed.DataParallel(net)
+    model = paddle.Model(dp_net)
+    model.prepare(paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    model.fit(Ds(), batch_size=16, epochs=6, shuffle=False, verbose=0)
+    res = model.evaluate(Ds(), batch_size=16, verbose=0)
+    assert res["acc"] > 0.7, res

@@ -255,3 +255,33 @@ def test_inplace_op_in_static_program_and_feed_shape():
         np.testing.assert_allclose(ao, expect2)
     finally:
         paddle.disable_static()
+
+
+def test_static_program_records_amp_autocast():
+    """Recording under amp.auto_cast captures the O1 dtype policy in the
+    program (reference static AMP: fluid/contrib/mixed_precision rewrites
+    the program with casts; here the recorded fwd autocasts)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        main, startup = paddle.static.Program(), paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [4, 8], "float32")
+            w = paddle.static.data("w", [8, 8], "float32")
+            with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                y = paddle.matmul(x, w)  # white-list op: bf16 under O1
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            (out,) = exe.run(
+                main,
+                feed={"x": np.full((4, 8), 1.0 + 2**-10, np.float32),
+                      "w": np.eye(8, dtype=np.float32)},
+                fetch_list=[y])
+        assert str(out.dtype) == "bfloat16", out.dtype
+        # bf16 rounding proves the matmul really ran in low precision
+        assert float(np.asarray(out, np.float32)[0, 0]) in (1.0, 1.0078125)
+    finally:
+        paddle.disable_static()

@@ -13,6 +13,7 @@ from paddle_tpu.vision.models import LeNet
 
 def test_lenet_mnist_training_loss_decreases():
     paddle.seed(0)
+    np.random.seed(0)  # DataLoader shuffle order: decouple from prior tests
     train_ds = MNIST(mode="train")
     loader = DataLoader(train_ds, batch_size=64, shuffle=True, drop_last=True)
     model = LeNet(num_classes=10)
