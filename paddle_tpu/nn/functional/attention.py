@@ -20,20 +20,23 @@ from ...framework import random as rnd
 from ...ops.dispatch import op
 
 
-def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
+def _flash_ok(q_shape, k_shape, mask, dropout_p, training, mask_trainable=False):
     """Pallas flash path: TPU (or interpret-mode) backend, MXU-tileable
-    sequence lengths, no attention dropout (dropout needs the probs), and —
-    when a mask is given — a mask the kernel streams exactly: trailing dims
-    ``(sq, sk)`` with broadcastable batch/head dims. Trainable biases are
-    supported: the fused backward computes the real dS-sum bias gradient
-    (XLA-DCE'd when unused)."""
+    sequence lengths, and — when a mask is given — a mask the kernel streams
+    exactly: trailing dims ``(sq, sk)`` with broadcastable batch/head dims.
+    Trainable biases are supported: the fused backward computes the real
+    dS-sum bias gradient (XLA-DCE'd when unused). Attention dropout runs
+    in-kernel via the TPU hardware PRNG — compiled-TPU only (no interpret
+    lowering) and incompatible with a trainable bias (the XLA dbias
+    recompute cannot regenerate the in-kernel mask)."""
     from ...framework.flags import flag_value
     from ...ops import pallas
 
     if flag_value("disable_flash_attention"):
         return False
     if dropout_p > 0.0 and training:
-        return False
+        if pallas.interpret_requested() or mask_trainable:
+            return False
     sq, sk = q_shape[1], k_shape[1]
     # Routing by measured crossover (v5e): below sq*sk = 1024^2 XLA's fused
     # einsum attention wins; at 1024^2+ the Pallas kernel with 1024-wide
@@ -58,13 +61,14 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training):
 
 
 @op("flash_sdpa")
-def _sdpa_flash(q, k, v, mask=None, causal=False, scale=None,
-                mask_trainable=False):
+def _sdpa_flash(q, k, v, mask=None, dropout_seed=None, causal=False,
+                scale=None, mask_trainable=False, dropout_p=0.0):
     """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
     from ...ops.pallas.flash_attention import flash_attention as fa
 
     return fa(q, k, v, bias=mask, causal=causal, scale=scale,
-              bias_grad=mask_trainable)
+              bias_grad=mask_trainable,
+              dropout_p=dropout_p, dropout_seed=dropout_seed)
 
 
 @op("sdpa")
@@ -95,11 +99,20 @@ def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
 
 def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
           training=True, scale=None):
-    if _flash_ok(query.shape, key.shape, attn_mask, dropout_p, training):
-        trainable = (attn_mask is not None
-                     and getattr(attn_mask, "stop_gradient", True) is False)
-        return _sdpa_flash(query, key, value, attn_mask, causal=is_causal,
-                           scale=scale, mask_trainable=trainable)
+    trainable = (attn_mask is not None
+                 and getattr(attn_mask, "stop_gradient", True) is False)
+    if _flash_ok(query.shape, key.shape, attn_mask, dropout_p, training,
+                 trainable):
+        active_p = dropout_p if training else 0.0
+        seed = None
+        if active_p > 0.0:
+            # two 32-bit words of a fresh key seed the in-kernel PRNG
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.bits(rnd.next_key(), (2,), jnp.uint32), jnp.int32
+            )
+        return _sdpa_flash(query, key, value, attn_mask, seed,
+                           causal=is_causal, scale=scale,
+                           mask_trainable=trainable, dropout_p=active_p)
     dropout_mask = None
     if dropout_p > 0.0 and training:
         b, sq, h, _ = query.shape

@@ -18,6 +18,20 @@ equal to the full array dim keeps the block legal for Mosaic while staying
 materialized — the backward kernels recompute it per tile from the streamed
 ``o`` block.  head_dim is used unpadded (block dim = full array dim).
 
+Attention dropout runs IN-KERNEL via the TPU hardware PRNG
+(``pltpu.prng_seed`` / ``prng_random_bits``): every kernel (fwd, dq, dk/dv)
+re-seeds per (batch, head, q_block, k_block) tile from the caller's seed, so
+the three kernels regenerate the identical keep-mask without ever
+materializing a ``[b,h,s,s]`` mask in HBM — the same design as the reference
+CUDA kernel's in-kernel curand dropout
+(``paddle/fluid/operators/fused/fused_attention_op.cu``). Dropout is applied
+post-softmax: the l-normalizer accumulates the *undropped* p, the output
+accumulates the dropped one. Backward identities (with ``P_d = P·M/keep``):
+``delta = rowsum(dO∘O) = Σ_k P_d·dP_d`` still holds, so
+``dS = P∘(dP·M/keep − delta)`` and ``dV = P_dᵀ·dO``. Hardware PRNG has no
+interpret-mode lowering, so dropout requires a real TPU backend (the F.sdpa
+router falls back to the einsum path on CPU).
+
 Layout: public API takes paddle layout ``(batch, seq, heads, head_dim)``.
 """
 from __future__ import annotations
@@ -27,6 +41,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -57,6 +72,29 @@ def _causal_run(qi, ki, block_q, block_k, offset):
     return qi * block_q + block_q - 1 + offset >= ki * block_k
 
 
+def _dropout_mask(seed_ref, qi, ki, shape, dropout_p):
+    """Regenerate the per-tile keep mask from the hardware PRNG. The tile
+    coordinates are folded into the two user seed words (``prng_seed``
+    accepts at most two scalars through this toolchain) so fwd/dq/dkv
+    kernels — whatever their grid order — draw identical bits for the same
+    (batch, head, q_block, k_block) tile: distinct tiles map to distinct
+    seed pairs (qi, ki < 2^16; heads < 2^10)."""
+    bb, hh = pl.program_id(0), pl.program_id(1)
+    pltpu.prng_seed(seed_ref[0] ^ (qi * 65536 + ki),
+                    seed_ref[1] ^ (bb * 1024 + hh))
+    # 16 random bits per element suffice for the keep test (rate resolution
+    # 1/65536) and halve the PRNG work vs 32: draw half the sublanes as
+    # uint32, bitcast to uint16 (which doubles the sublane dim back).
+    bits = pltpu.bitcast(
+        pltpu.prng_random_bits((shape[0] // 2, shape[1])), jnp.uint16
+    )
+    keep = 1.0 - dropout_p
+    thr = min(int(keep * 65536.0), 65535)
+    # compare in int32: the VPU has no 16-bit compare ("Target does not
+    # support this comparison"); the widening is cheap relative to PRNG
+    return bits.astype(jnp.int32) < thr
+
+
 def _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q, block_k,
             offset):
     s = jax.lax.dot_general(
@@ -70,9 +108,9 @@ def _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q, block_k,
     return s
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k,
-                offset):
+                offset, dropout_p):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -92,7 +130,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # l accumulates the UNdropped p (softmax normalizes pre-dropout);
+        # only the value matmul sees the dropped probabilities.
         l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_p > 0.0:
+            keep = _dropout_mask(seed_ref, qi, ki, s.shape, dropout_p)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0, 0],
             (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
@@ -111,8 +154,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
             )
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k, offset):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                   lse_ref, dq_ref, dq_acc, *, scale, causal, block_q,
+                   block_k, offset, dropout_p):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -130,7 +174,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
         do = do_ref[0, 0]
         # delta = rowsum(do * o): recomputed per tile from the streamed o
         # block — elementwise O(block_q*d), far cheaper than materializing a
-        # lane-broadcast (b,h,sq,128) delta array in HBM
+        # lane-broadcast (b,h,sq,128) delta array in HBM. With dropout this
+        # equals Σ_k P_d·dP_d, exactly the softmax-jacobian rowsum needed.
         delta = jnp.sum(
             do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
             axis=-1, keepdims=True,
@@ -139,6 +184,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
             do, v_ref[0, 0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
         )
+        if dropout_p > 0.0:
+            keep = _dropout_mask(seed_ref, qi, ki, s.shape, dropout_p)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
         ds = p * (dp - delta) * scale
         dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0, 0],
@@ -150,9 +198,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, offset):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                    lse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, offset, dropout_p):
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
 
@@ -173,13 +221,20 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, b_ref, do_ref, o_ref, lse_ref,
             do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),
             axis=-1, keepdims=True,
         )
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p.astype(do.dtype), do,
-            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        )
         dp = jax.lax.dot_general(
             do, v_ref[0, 0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        if dropout_p > 0.0:
+            keep = _dropout_mask(seed_ref, qi, ki, s.shape, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            p_d = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            p_d = p
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_d.astype(do.dtype), do,
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
         dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
@@ -211,13 +266,15 @@ def _bias_spec(bias, block_q, block_k, kv_major=False):
     return pl.BlockSpec((1, 1, block_q, block_k), imap)
 
 
-def _wrap_nobias(kernel, bias_pos):
-    """Adapt a kernel expecting a bias ref at ``bias_pos`` to the no-bias call
-    signature by injecting ``None``."""
+def _inject_none(kernel, *positions):
+    """Adapt a kernel to a call signature missing some refs (seed / bias /
+    lse) by inserting ``None`` at the given positions of the kernel's FULL
+    signature (ascending insertion keeps later indices valid)."""
 
     def wrapped(*refs):
         refs = list(refs)
-        refs.insert(bias_pos, None)
+        for p in sorted(positions):
+            refs.insert(p, None)
         return kernel(*refs)
 
     return wrapped
@@ -234,16 +291,21 @@ def _check_shapes(q, k, v, bias):
     return b, h, sq, sk, d
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, bias, scale, causal, block_q, block_k, interpret,
-           need_dbias):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, seed, scale, causal, block_q, block_k, interpret,
+           need_dbias, dropout_p):
     # primal path (inference / no grad): skip the logsumexp output entirely
-    return _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
-                           interpret, need_stats=False)
+    return _flash_fwd_impl(q, k, v, bias, seed, scale, causal, block_q,
+                           block_k, interpret, dropout_p, need_stats=False)
 
 
-def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
-                    need_stats=True):
+def _seed_spec(seed):
+    # whole (2,) int32 seed in SMEM, identical for every grid step
+    return None if seed is None else pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _flash_fwd_impl(q, k, v, bias, seed, scale, causal, block_q, block_k,
+                    interpret, dropout_p, need_stats=True):
     b, h, sq, sk, d = _check_shapes(q, k, v, bias)
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
@@ -255,6 +317,7 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
         return (bb, hh, ki, 0)
 
     in_specs = [
+        _seed_spec(seed),
         pl.BlockSpec((1, 1, block_q, d), qmap),
         pl.BlockSpec((1, 1, block_k, d), kmap),
         pl.BlockSpec((1, 1, block_k, d), kmap),
@@ -262,10 +325,14 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
     ]
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=offset,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
     )
+    # full kernel signature: (seed, q, k, v, bias, o, lse, <scratch>)
+    missing = []
+    if seed is None:
+        missing.append(0)
     if bias is None:
-        kernel = _wrap_nobias(kernel, 3)
+        missing.append(4)
     if need_stats:
         out_specs = [
             pl.BlockSpec((1, 1, block_q, d), qmap),
@@ -277,10 +344,11 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
             jax.ShapeDtypeStruct((b, h, sq, STAT_LANES), jnp.float32),
         ]
     else:
-        # inject lse_ref=None: kernel args are (q, k, v, bias, o, <lse>, ...)
-        kernel = _wrap_nobias(kernel, 5 if bias is not None else 4)
+        missing.append(6)
         out_specs = pl.BlockSpec((1, 1, block_q, d), qmap)
         out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if missing:
+        kernel = _inject_none(kernel, *missing)
     result = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -298,19 +366,20 @@ def _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k, interpret,
             bytes_accessed=int(2 * (q.size + k.size + v.size + q.size)),
             transcendentals=int(b * h * sq * sk),
         ),
-    )(*[x for x in (q, k, v, bias) if x is not None])
+    )(*[x for x in (seed, q, k, v, bias) if x is not None])
     return result
 
 
-def _flash_fwd(q, k, v, bias, scale, causal, block_q, block_k, interpret,
-               need_dbias):
-    out, lse = _flash_fwd_impl(q, k, v, bias, scale, causal, block_q, block_k,
-                               interpret, need_stats=True)
-    return out, (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, seed, scale, causal, block_q, block_k,
+               interpret, need_dbias, dropout_p):
+    out, lse = _flash_fwd_impl(q, k, v, bias, seed, scale, causal, block_q,
+                               block_k, interpret, dropout_p, need_stats=True)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
-    q, k, v, bias, out, lse = res
+def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias,
+               dropout_p, res, g):
+    q, k, v, bias, seed, out, lse = res
     b, h, sq, sk, d = _check_shapes(q, k, v, bias)
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
@@ -323,11 +392,14 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=offset,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
     )
-    if bias is None:
-        dq_kernel = _wrap_nobias(dq_kernel, 3)
+    # full kernel signature: (seed, q, k, v, bias, do, o, lse, dq, <scratch>)
+    missing = ([0] if seed is None else []) + ([4] if bias is None else [])
+    if missing:
+        dq_kernel = _inject_none(dq_kernel, *missing)
     dq_specs = [
+        _seed_spec(seed),                              # seed
         pl.BlockSpec((1, 1, block_q, d), qmap),        # q
         pl.BlockSpec((1, 1, block_k, d), kmap),        # k
         pl.BlockSpec((1, 1, block_k, d), kmap),        # v
@@ -345,7 +417,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias, g, out, lse) if x is not None])
+    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
 
     # dk/dv sweep: grid (b, h, k_block, q_block) so the per-k-block
     # accumulators persist in scratch across the q sweep.
@@ -357,11 +429,14 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=offset,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
     )
-    if bias is None:
-        dkv_kernel = _wrap_nobias(dkv_kernel, 3)
+    # full signature: (seed, q, k, v, bias, do, o, lse, dk, dv, <scratch>)
+    missing = ([0] if seed is None else []) + ([4] if bias is None else [])
+    if missing:
+        dkv_kernel = _inject_none(dkv_kernel, *missing)
     dkv_specs = [
+        _seed_spec(seed),                              # seed
         pl.BlockSpec((1, 1, block_q, d), kv_qmap),     # q
         pl.BlockSpec((1, 1, block_k, d), kv_kmap),     # k
         pl.BlockSpec((1, 1, block_k, d), kv_kmap),     # v
@@ -388,7 +463,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(*[x for x in (q, k, v, bias, g, out, lse) if x is not None])
+    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
 
     if bias is None:
         dbias = None
@@ -419,7 +494,9 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias, res, g):
         red = tuple(i for i in (0, 1) if bias.shape[i] == 1)
         dbias = jnp.sum(ds, axis=red, keepdims=True) if red else ds
         dbias = dbias.astype(bias.dtype)
-    return dq, dk, dv, dbias
+    # integer seed gets a float0 cotangent (jax's tangent type for ints)
+    dseed = None if seed is None else np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -447,7 +524,8 @@ def supports(seq_q, seq_k, head_dim=None,
 
 def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    interpret=None, bias_grad=True):
+                    interpret=None, bias_grad=True,
+                    dropout_p=0.0, dropout_seed=None):
     """Blockwise flash attention.
 
     Args:
@@ -462,6 +540,12 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
       causal: bottom-right-aligned causal mask (row r attends keys
         ``<= r + sk - sq``, matching softmax-attention convention).
       scale: softmax scale; default ``1/sqrt(head_dim)``.
+      dropout_p: attention-probability dropout rate, applied IN-KERNEL via
+        the TPU hardware PRNG (no HBM mask). Requires ``dropout_seed`` and a
+        compiled TPU backend (no interpret-mode lowering exists for the
+        hardware PRNG). Deterministic given the seed.
+      dropout_seed: ``(2,)`` int32 array; fwd and bwd kernels re-derive the
+        identical keep mask from it per (batch, head, q_block, k_block) tile.
 
     Returns ``(batch, seq_q, heads, head_dim)``.
     """
@@ -470,6 +554,30 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
 
     if interpret is None:
         interpret = interpret_requested()
+    dropout_p = float(dropout_p)
+    if dropout_p > 0.0:
+        if interpret:
+            raise ValueError(
+                "in-kernel attention dropout needs the TPU hardware PRNG; "
+                "no interpret-mode lowering exists (use the einsum path)"
+            )
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        if bias is not None and bias_grad:
+            raise ValueError(
+                "bias_grad with attention dropout is unsupported: the XLA "
+                "dbias recompute cannot regenerate the in-kernel PRNG mask "
+                "(pass bias_grad=False for constant masks)"
+            )
+        if q.shape[2] >= 1024:
+            # the per-tile seed fold packs the head index into 10 bits;
+            # beyond that distinct heads would silently share keep-masks
+            raise ValueError(
+                f"in-kernel dropout supports < 1024 heads (got {q.shape[2]})"
+            )
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(2)
+    else:
+        seed = None
     block_q = flag_value("flash_attention_block_q") or block_q
     block_k = flag_value("flash_attention_block_k") or block_k
     b, sq, h, d = q.shape
@@ -501,7 +609,7 @@ def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
         else:
             bias = bias.astype(jnp.float32)
         bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
-    out = _flash(qt, kt, vt, bias, float(scale), bool(causal),
+    out = _flash(qt, kt, vt, bias, seed, float(scale), bool(causal),
                  int(block_q), int(block_k), bool(interpret),
-                 bool(bias_grad) and bias is not None)
+                 bool(bias_grad) and bias is not None, dropout_p)
     return jnp.swapaxes(out, 1, 2)

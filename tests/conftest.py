@@ -11,14 +11,19 @@ import os
 # its sitecustomize imports jax at interpreter start, so env vars are too
 # late — switch platform via jax.config before any backend use. Unit tests
 # must run on the virtual 8-device CPU mesh regardless of hardware.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# PADDLE_TPU_HW_TESTS=1 opts out, keeping the real TPU backend for the
+# hardware-only tests (in-kernel PRNG dropout etc.) that skip on CPU.
+_HW = os.environ.get("PADDLE_TPU_HW_TESTS") == "1"
+if not _HW:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _HW:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent compilation cache: the eager path compiles one executable per
