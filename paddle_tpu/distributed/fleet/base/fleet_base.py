@@ -130,7 +130,16 @@ class Fleet:
         if strat.dgc:
             from ....optimizer import Momentum
 
-            if isinstance(optimizer, Momentum):
+            if not isinstance(optimizer, Momentum):
+                # reference applicability check: DGC is a Momentum variant;
+                # silently training without it would misreport the strategy
+                import warnings
+
+                warnings.warn(
+                    "DistributedStrategy.dgc requires a Momentum optimizer; "
+                    f"got {type(optimizer).__name__} — DGC is NOT applied"
+                )
+            else:
                 cfg = strat.dgc_configs
                 optimizer = DGCMomentumOptimizer(
                     learning_rate=optimizer._learning_rate,

@@ -30,13 +30,26 @@ __all__ = ["group_sharded_parallel", "save_group_sharded_model", "ShardedLayer"]
 
 
 def _axis_sharding(group, ndim, shape, offload=False):
-    """Shard dim0 over the group axis when divisible, else replicate (the
-    reference pads/flattens into rank buffers; XLA needs divisibility).
+    """Shard the FIRST evenly-divisible dim over the group axis.
+
+    The reference handles awkward shapes by flattening params into padded
+    per-rank flat buffers (``group_sharded_storage.py``) — a CUDA artifact:
+    NCCL reduce-scatter wants contiguous equal chunks. XLA shards any dim
+    equally well, so the TPU-native equivalent of pad-and-flatten is simply
+    to pick a dim that divides: dim0 when possible (classic ZeRO rows),
+    else the next divisible dim — e.g. a (50257, 768) GPT-2 embedding at
+    degree 8 shards its hidden dim for an exact 1/8 per-device footprint,
+    where dim0-only placement would silently replicate all 154 MB of
+    fp32 Adam state. Replication remains only for tensors with NO
+    divisible dim (odd-length 1-D params — hundreds of KB, not MB).
+
     ``offload=True`` additionally places the buffer in host memory
     (reference offload_helper.py; TPU: pinned_host memory space)."""
-    spec = (P(group.axis_name)
-            if ndim >= 1 and shape[0] % group.nranks == 0 and shape[0] > 0
-            else P())
+    spec = P()
+    for axis in range(ndim):
+        if shape[axis] > 0 and shape[axis] % group.nranks == 0:
+            spec = P(*([None] * axis + [group.axis_name]))
+            break
     sh = NamedSharding(group.mesh, spec)
     if offload:
         try:

@@ -64,17 +64,28 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, backend=None,
         procs.append(p)
     if not join:
         return procs
-    for p in procs:
-        p.join()
-    fails = [p for p in procs if p.exitcode != 0]
-    if fails:
-        msg = ""
+    # drain err_q WHILE joining: a failing worker whose traceback exceeds
+    # the queue's pipe buffer blocks in its feeder thread until someone
+    # reads — joining first would deadlock against that thread
+    tracebacks = []
+
+    def _drain():
         try:
             while True:
-                rank, tb = err_q.get_nowait()
-                msg += f"\n----- rank {rank} -----\n{tb}"
+                tracebacks.append(err_q.get_nowait())
         except Exception:
             pass
+
+    for p in procs:
+        while p.is_alive():
+            p.join(timeout=0.2)
+            _drain()
+        p.join()
+    _drain()
+    fails = [p for p in procs if p.exitcode != 0]
+    if fails:
+        msg = "".join(f"\n----- rank {rank} -----\n{tb}"
+                      for rank, tb in tracebacks)
         raise RuntimeError(
             f"{len(fails)}/{nprocs} spawned workers failed{msg or ' (no traceback captured)'}"
         )

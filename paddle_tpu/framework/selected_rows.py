@@ -73,6 +73,7 @@ class SparseGradTensor(Tensor):
         # base __init__ wrote a placeholder through the property setter —
         # drop it so the first real read densifies the SelectedRows
         self._dense_cache = None
+        self._demoted = False   # True once a dense write diverged from _sr
 
     @property
     def selected_rows(self):
@@ -88,10 +89,18 @@ class SparseGradTensor(Tensor):
     def _value(self, v):
         # dense writes (e.g. grad clip rescale) demote to a plain dense cache
         self._dense_cache = v
+        self._demoted = True
 
     def accumulate(self, other):
         if isinstance(other, SelectedRows):
-            self._sr = self._sr.append(other)
-            self._dense_cache = None
+            if getattr(self, "_demoted", False):
+                # a dense write (e.g. grad-clip rescale) diverged the cache
+                # from _sr; dropping the cache here would discard it —
+                # densify the incoming rows into the cache instead
+                self._dense_cache = self._dense_cache + other.to_dense()
+            else:
+                self._sr = self._sr.append(other)
+                self._dense_cache = None
         else:
             self._dense_cache = self._value + other
+            self._demoted = True

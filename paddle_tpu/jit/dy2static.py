@@ -35,6 +35,10 @@ Deliberate contract differences from the reference (documented, checked):
 - a name assigned under a tensor-dependent ``if`` must either exist before
   the ``if`` or be assigned in **both** branches (the reference raises the
   same class of error at ProgramDesc build time for undefined vars).
+- a ``for range`` loop target that was undefined before the loop is seeded
+  with ``start`` so a zero-trip *symbolic* loop stays well-defined inside
+  the trace; plain Python would raise NameError when the target is read
+  after a loop that never ran (``convert_for_range``).
 """
 from __future__ import annotations
 

@@ -63,9 +63,25 @@ def _flash_ok(q_shape, k_shape, mask, dropout_p, training, mask_trainable=False)
 @op("flash_sdpa")
 def _sdpa_flash(q, k, v, mask=None, dropout_seed=None, causal=False,
                 scale=None, mask_trainable=False, dropout_p=0.0):
-    """q,k,v: (batch, seq, heads, head_dim) — paddle layout."""
+    """q,k,v: (batch, seq, heads, head_dim) — paddle layout.
+
+    Prefers the seq-major packed kernel (zero layout transposes — the
+    (b,s,h,d)->(b,s,h*d) reshape is free) whenever the head dim packs into
+    128-lane groups and the mask is shared-2-D/absent; per-batch/per-head
+    or trainable biases take the layout-swapping kernel."""
+    from ...ops.pallas import flash_attention_packed as packed
     from ...ops.pallas.flash_attention import flash_attention as fa
 
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    mask_2d = mask is not None and mask.ndim == 2
+    if ((mask is None or (mask_2d and not mask_trainable))
+            and packed.supports(sq, sk, h, h * d)):
+        out = packed.flash_attention_packed(
+            q.reshape(b, sq, h * d), k.reshape(b, sk, h * d),
+            v.reshape(b, sk, h * d), h, bias=mask, causal=causal,
+            scale=scale, dropout_p=dropout_p, dropout_seed=dropout_seed)
+        return out.reshape(b, sq, h, d)
     return fa(q, k, v, bias=mask, causal=causal, scale=scale,
               bias_grad=mask_trainable,
               dropout_p=dropout_p, dropout_seed=dropout_seed)

@@ -72,14 +72,17 @@ def _causal_run(qi, ki, block_q, block_k, offset):
     return qi * block_q + block_q - 1 + offset >= ki * block_k
 
 
-def _dropout_mask(seed_ref, qi, ki, shape, dropout_p):
+def _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=None):
     """Regenerate the per-tile keep mask from the hardware PRNG. The tile
     coordinates are folded into the two user seed words (``prng_seed``
     accepts at most two scalars through this toolchain) so fwd/dq/dkv
     kernels — whatever their grid order — draw identical bits for the same
     (batch, head, q_block, k_block) tile: distinct tiles map to distinct
-    seed pairs (qi, ki < 2^16; heads < 2^10)."""
-    bb, hh = pl.program_id(0), pl.program_id(1)
+    seed pairs (qi, ki < 2^16; heads < 2^10). ``head`` is the static head
+    index for kernels that unroll heads in-kernel (the packed layout);
+    the layout-swapping kernels carry the head on grid axis 1."""
+    bb = pl.program_id(0)
+    hh = pl.program_id(1) if head is None else head
     pltpu.prng_seed(seed_ref[0] ^ (qi * 65536 + ki),
                     seed_ref[1] ^ (bb * 1024 + hh))
     # 16 random bits per element suffice for the keep test (rate resolution

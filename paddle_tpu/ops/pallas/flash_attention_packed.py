@@ -1,0 +1,539 @@
+"""Seq-major ("packed") flash attention: kernels that read the model's
+native ``(batch, seq, heads*head_dim)`` activation layout directly.
+
+Motivation (measured on v5e, GPT-2 124M b16 s1024): the layout-swapping
+``flash_attention`` kernel forces ``(b,s,h,d) <-> (b,h,s,d)`` transposes
+around every attention call — fwd q/k/v + out, and their autodiff duals —
+which profiled at ~14% of device step time (24 standalone transpose ops,
+~25 ms/step).  These kernels eliminate every one of those transposes: the
+qkv-projection output feeds the kernel as-is and the kernel output feeds
+the out-projection as-is.
+
+Design: the grid is ``(batch, head_group, q_block, k_block)`` where a head
+group is the set of heads whose packed lane range spans exactly 128 lanes
+(2 heads at d=64, 1 at d=128, 4 at d=32 …).  Each q/k/v/o block is a
+``(1, block, 128)`` slice of the packed array selected purely by the
+BlockSpec index map — 128-lane alignment keeps Mosaic happy where per-head
+``(1, block, 1, d)`` blocks and dynamic head indexing are rejected (tried;
+see repo build notes) — and the kernel unrolls a static loop over the
+heads inside the group, slicing each head's ``d``-wide lane range with
+static offsets (Mosaic accepts static 64-aligned lane slices).  A VMEM-
+budget bonus vs a full-embedding block: per-head softmax-stat tiles pad
+their 8-lane minor dim to 128 lanes, so carrying all ``h`` heads in one
+kernel instance costs ``h``× that padding; a head group carries at most
+128/d of it (the full-E variant OOM'd scoped VMEM at 18 MB > 16 MB).
+
+Same math as ``flash_attention.py`` (online softmax fwd; FlashAttention-2
+split dq / dk+dv backward recomputing p from the saved logsumexp; in-kernel
+hardware-PRNG dropout with the per-tile reseed scheme).  Supports causal
+masking, an optional SHARED 2-D additive bias ``(sq, sk)`` (streamed
+per-tile; per-batch/per-head 4-D biases route to the layout-swapping
+kernel), and dropout.
+
+Reference capability: fused attention fwd+bwd in
+``paddle/fluid/operators/fused/fused_attention_op.cu`` / ``fmha_ref.h``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import (
+    LANES,
+    NEG_INF,
+    STAT_LANES,
+    _causal_mask,
+    _causal_run,
+    _dropout_mask,
+    _inject_none,
+    _pick_block,
+)
+
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+DEFAULT_BWD_BLOCK = 512
+
+
+def _group_width(d):
+    """(heads_per_group, lane width of one group block)."""
+    if d >= LANES:
+        return (1, d) if d % LANES == 0 else (0, 0)
+    return (LANES // d, LANES) if LANES % d == 0 else (0, 0)
+
+
+def _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale, causal,
+                 block_q, block_k, offset):
+    qh = q_ref[0, :, j * d:(j + 1) * d]
+    kh = k_ref[0, :, j * d:(j + 1) * d]
+    s = jax.lax.dot_general(
+        qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    if b_ref is not None:
+        s = s + b_ref[...].astype(jnp.float32)
+    if causal:
+        s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+    return s
+
+
+def _drop(seed_ref, j, hpg, qi, ki, shape, dropout_p):
+    # global head index = group * heads_per_group + static offset
+    head = pl.program_id(1) * hpg + j
+    return _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=head)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, hpg, d, scale, causal, block_q,
+                block_k, offset, dropout_p):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _body():
+        for j in range(hpg):
+            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
+                             causal, block_q, block_k, offset)
+            m_prev = m_ref[j][:, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_ref[j][:, 0:1] * alpha + jnp.sum(p, axis=-1,
+                                                       keepdims=True)
+            if dropout_p > 0.0:
+                keep = _drop(seed_ref, j, hpg, qi, ki, s.shape, dropout_p)
+                p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+            vh = v_ref[0, :, j * d:(j + 1) * d]
+            acc_ref[0, :, j * d:(j + 1) * d] = (
+                acc_ref[0, :, j * d:(j + 1) * d] * alpha
+                + jax.lax.dot_general(
+                    p.astype(vh.dtype), vh,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            m_ref[j] = jnp.broadcast_to(m_new, m_ref.shape[1:])
+            l_ref[j] = jnp.broadcast_to(l_new, l_ref.shape[1:])
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        for j in range(hpg):
+            l = l_ref[j][:, 0:1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, j * d:(j + 1) * d] = (
+                acc_ref[0, :, j * d:(j + 1) * d] / l_safe
+            ).astype(o_ref.dtype)
+            if lse_ref is not None:
+                lse_ref[0, j] = jnp.broadcast_to(
+                    m_ref[j][:, 0:1] + jnp.log(l_safe), lse_ref.shape[2:]
+                )
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                   lse_ref, dq_ref, dq_acc, *, hpg, d, scale, causal,
+                   block_q, block_k, offset, dropout_p):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (ki >= 0)
+
+    @pl.when(run)
+    def _body():
+        for j in range(hpg):
+            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
+                             causal, block_q, block_k, offset)
+            p = jnp.exp(s - lse_ref[0, j][:, 0:1])
+            doh = do_ref[0, :, j * d:(j + 1) * d]
+            oh = o_ref[0, :, j * d:(j + 1) * d]
+            delta = jnp.sum(
+                doh.astype(jnp.float32) * oh.astype(jnp.float32),
+                axis=-1, keepdims=True,
+            )
+            vh = v_ref[0, :, j * d:(j + 1) * d]
+            dp = jax.lax.dot_general(
+                doh, vh,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+            if dropout_p > 0.0:
+                keep = _drop(seed_ref, j, hpg, qi, ki, s.shape, dropout_p)
+                dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
+            ds = p * (dp - delta) * scale
+            kh = k_ref[0, :, j * d:(j + 1) * d]
+            dq_acc[0, :, j * d:(j + 1) * d] = (
+                dq_acc[0, :, j * d:(j + 1) * d] + jax.lax.dot_general(
+                    ds.astype(kh.dtype), kh,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[0].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                    lse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, hpg, d,
+                    scale, causal, block_q, block_k, offset, dropout_p):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (qi >= 0)
+
+    @pl.when(run)
+    def _body():
+        for j in range(hpg):
+            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
+                             causal, block_q, block_k, offset)
+            p = jnp.exp(s - lse_ref[0, j][:, 0:1])
+            doh = do_ref[0, :, j * d:(j + 1) * d]
+            oh = o_ref[0, :, j * d:(j + 1) * d]
+            delta = jnp.sum(
+                doh.astype(jnp.float32) * oh.astype(jnp.float32),
+                axis=-1, keepdims=True,
+            )
+            vh = v_ref[0, :, j * d:(j + 1) * d]
+            dp = jax.lax.dot_general(
+                doh, vh,
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+            if dropout_p > 0.0:
+                keep = _drop(seed_ref, j, hpg, qi, ki, s.shape, dropout_p)
+                inv = 1.0 / (1.0 - dropout_p)
+                p_d = jnp.where(keep, p * inv, 0.0)
+                dp = jnp.where(keep, dp * inv, 0.0)
+            else:
+                p_d = p
+            dv_acc[0, :, j * d:(j + 1) * d] = (
+                dv_acc[0, :, j * d:(j + 1) * d] + jax.lax.dot_general(
+                    p_d.astype(doh.dtype), doh,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            ds = p * (dp - delta) * scale
+            qh = q_ref[0, :, j * d:(j + 1) * d]
+            dk_acc[0, :, j * d:(j + 1) * d] = (
+                dk_acc[0, :, j * d:(j + 1) * d] + jax.lax.dot_general(
+                    ds.astype(qh.dtype), qh,
+                    (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[0].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[0].astype(dv_ref.dtype)
+
+
+def _seed_spec(seed):
+    return None if seed is None else pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _bias_spec(bias, block_q, block_k, kv_major=False):
+    """Shared 2-D (sq, sk) bias, streamed per (q_block, k_block) tile."""
+    if bias is None:
+        return None
+    if kv_major:
+        return pl.BlockSpec((block_q, block_k),
+                            lambda bb, hg, ki, qi: (qi, ki))
+    return pl.BlockSpec((block_q, block_k), lambda bb, hg, qi, ki: (qi, ki))
+
+
+def _check(q, k, v, h):
+    b, sq, e = q.shape
+    bk, sk, ek = k.shape
+    assert v.shape == k.shape, (v.shape, k.shape)
+    assert (bk, ek) == (b, e), (q.shape, k.shape)
+    assert e % h == 0, (e, h)
+    return b, sq, sk, e // h
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(5, 6, 7, 8, 9, 10, 11, 12))
+def _flash(q, k, v, bias, seed, h, scale, causal, block_q, block_k,
+           interpret, dropout_p, bwd_block):
+    return _fwd_impl(q, k, v, bias, seed, h, scale, causal, block_q, block_k,
+                     interpret, dropout_p, need_stats=False)
+
+
+def _fwd_impl(q, k, v, bias, seed, h, scale, causal, block_q, block_k,
+              interpret, dropout_p, need_stats=True):
+    b, sq, sk, d = _check(q, k, v, h)
+    hpg, width = _group_width(d)
+    ng = h // hpg
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+
+    def qmap(bb, hg, qi, ki):
+        return (bb, qi, hg)
+
+    def kmap(bb, hg, qi, ki):
+        return (bb, ki, hg)
+
+    in_specs = [
+        _seed_spec(seed),
+        pl.BlockSpec((1, block_q, width), qmap),
+        pl.BlockSpec((1, block_k, width), kmap),
+        pl.BlockSpec((1, block_k, width), kmap),
+        _bias_spec(bias, block_q, block_k),
+    ]
+    kernel = functools.partial(
+        _fwd_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
+    )
+    # full kernel signature: (seed, q, k, v, bias, o, lse, <scratch>)
+    missing = ([0] if seed is None else []) + ([4] if bias is None else [])
+    if need_stats:
+        out_specs = [
+            pl.BlockSpec((1, block_q, width), qmap),
+            pl.BlockSpec((1, hpg, block_q, STAT_LANES),
+                         lambda bb, hg, qi, ki: (bb, hg, qi, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, STAT_LANES), jnp.float32),
+        ]
+    else:
+        missing.append(6)
+        out_specs = pl.BlockSpec((1, block_q, width), qmap)
+        out_shape = jax.ShapeDtypeStruct(q.shape, q.dtype)
+    if missing:
+        kernel = _inject_none(kernel, *missing)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, ng, nq, nk),
+        in_specs=[s for s in in_specs if s is not None],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((1, block_q, width), jnp.float32),
+            pltpu.VMEM((hpg, block_q, STAT_LANES), jnp.float32),
+            pltpu.VMEM((hpg, block_q, STAT_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * b * h * sq * sk * d * (0.5 if causal else 1.0)),
+            bytes_accessed=int(2 * (q.size + k.size + v.size + q.size)),
+            transcendentals=int(b * h * sq * sk),
+        ),
+    )(*[x for x in (seed, q, k, v, bias) if x is not None])
+
+
+def _fwd(q, k, v, bias, seed, h, scale, causal, block_q, block_k, interpret,
+         dropout_p, bwd_block):
+    out, lse = _fwd_impl(q, k, v, bias, seed, h, scale, causal, block_q,
+                         block_k, interpret, dropout_p, need_stats=True)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _bwd(h, scale, causal, block_q, block_k, interpret, dropout_p, bwd_block,
+         res, g):
+    q, k, v, bias, seed, out, lse = res
+    b, sq, sk, d = _check(q, k, v, h)
+    hpg, width = _group_width(d)
+    ng = h // hpg
+    # backward streams q/k/v + do/o + grads (~3x fwd working set): its own,
+    # smaller block size keeps it inside the 16 MB scoped-VMEM budget while
+    # the forward runs 1024-wide tiles
+    block_q = _pick_block(sq, bwd_block)
+    block_k = _pick_block(sk, bwd_block)
+    nq, nk = sq // block_q, sk // block_k
+    offset = sk - sq
+
+    def qmap(bb, hg, qi, ki):
+        return (bb, qi, hg)
+
+    def kmap(bb, hg, qi, ki):
+        return (bb, ki, hg)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
+    )
+    missing = ([0] if seed is None else []) + ([4] if bias is None else [])
+    if missing:
+        dq_kernel = _inject_none(dq_kernel, *missing)
+    dq_specs = [
+        _seed_spec(seed),
+        pl.BlockSpec((1, block_q, width), qmap),       # q
+        pl.BlockSpec((1, block_k, width), kmap),       # k
+        pl.BlockSpec((1, block_k, width), kmap),       # v
+        _bias_spec(bias, block_q, block_k),            # bias
+        pl.BlockSpec((1, block_q, width), qmap),       # do
+        pl.BlockSpec((1, block_q, width), qmap),       # o
+        pl.BlockSpec((1, hpg, block_q, STAT_LANES),
+                     lambda bb, hg, qi, ki: (bb, hg, qi, 0)),  # lse
+    ]
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, ng, nq, nk),
+        in_specs=[s for s in dq_specs if s is not None],
+        out_specs=pl.BlockSpec((1, block_q, width), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((1, block_q, width), jnp.float32)],
+        interpret=interpret,
+    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
+
+    def kv_qmap(bb, hg, ki, qi):
+        return (bb, qi, hg)
+
+    def kv_kmap(bb, hg, ki, qi):
+        return (bb, ki, hg)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
+    )
+    if missing:
+        dkv_kernel = _inject_none(dkv_kernel, *missing)
+    dkv_specs = [
+        _seed_spec(seed),
+        pl.BlockSpec((1, block_q, width), kv_qmap),    # q
+        pl.BlockSpec((1, block_k, width), kv_kmap),    # k
+        pl.BlockSpec((1, block_k, width), kv_kmap),    # v
+        _bias_spec(bias, block_q, block_k, kv_major=True),
+        pl.BlockSpec((1, block_q, width), kv_qmap),    # do
+        pl.BlockSpec((1, block_q, width), kv_qmap),    # o
+        pl.BlockSpec((1, hpg, block_q, STAT_LANES),
+                     lambda bb, hg, ki, qi: (bb, hg, qi, 0)),  # lse
+    ]
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, ng, nk, nq),
+        in_specs=[s for s in dkv_specs if s is not None],
+        out_specs=[
+            pl.BlockSpec((1, block_k, width), kv_kmap),
+            pl.BlockSpec((1, block_k, width), kv_kmap),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, block_k, width), jnp.float32),
+            pltpu.VMEM((1, block_k, width), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
+
+    if bias is None:
+        dbias = None
+    else:
+        # shared constant 2-D masks only (router guarantees stop_gradient)
+        dbias = jnp.zeros_like(bias)
+    dseed = None if seed is None else np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dbias, dseed
+
+
+_flash.defvjp(_fwd, _bwd)
+
+
+def supports(seq_q, seq_k, num_heads, embed_dim,
+             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Shape gate: lane-tileable seqs; head_dim must pack into 128-lane
+    groups (d a divisor or multiple of 128) with the head count divisible
+    by the group size."""
+    if embed_dim % num_heads:
+        return False
+    d = embed_dim // num_heads
+    hpg, _ = _group_width(d)
+    if not hpg or num_heads % hpg:
+        return False
+    return _pick_block(seq_q, block_q) > 0 and _pick_block(seq_k, block_k) > 0
+
+
+def flash_attention_packed(q, k, v, num_heads, bias=None, *, causal=False,
+                           scale=None, block_q=DEFAULT_BLOCK_Q,
+                           block_k=DEFAULT_BLOCK_K,
+                           bwd_block=DEFAULT_BWD_BLOCK, interpret=None,
+                           dropout_p=0.0, dropout_seed=None):
+    """Flash attention over packed ``(batch, seq, heads*head_dim)`` arrays.
+
+    Zero layout changes: inputs and output stay seq-major, exactly as the
+    qkv projection produces them and the out-projection consumes them.
+    ``bias`` (optional) must be a SHARED 2-D ``(sq, sk)`` additive mask
+    (constant — no bias gradient path); use :func:`flash_attention` for
+    per-batch/per-head biases.
+    """
+    from ...framework.flags import flag_value
+    from . import interpret_requested
+
+    if interpret is None:
+        interpret = interpret_requested()
+    b, sq, e = q.shape
+    sk = k.shape[1]
+    h = int(num_heads)
+    d = e // h
+    dropout_p = float(dropout_p)
+    if dropout_p > 0.0:
+        if interpret:
+            raise ValueError(
+                "in-kernel attention dropout needs the TPU hardware PRNG; "
+                "no interpret-mode lowering exists (use the einsum path)"
+            )
+        if dropout_seed is None:
+            raise ValueError("dropout_p > 0 requires dropout_seed")
+        if h >= 1024:
+            raise ValueError(
+                f"in-kernel dropout supports < 1024 heads (got {h})"
+            )
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(2)
+    else:
+        seed = None
+    block_q = flag_value("flash_attention_block_q") or block_q
+    block_k = flag_value("flash_attention_block_k") or block_k
+    bwd_block = flag_value("flash_attention_bwd_block") or bwd_block
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    bwd_block = _pick_block(sq, bwd_block) or block_q
+    if dropout_p > 0.0:
+        # the PRNG keep-mask is a function of (tile index, tile SHAPE):
+        # backward MUST re-tile exactly like the forward or dq/dkv would
+        # regenerate a mask uncorrelated with the one the forward applied
+        block_q = block_k = bwd_block = min(
+            x for x in (block_q, block_k, bwd_block) if x)
+    if not supports(sq, sk, h, e, block_q or 1, block_k or 1) \
+            or not (block_q and block_k):
+        raise ValueError(
+            f"flash_attention_packed needs 128-aligned seq blocks and "
+            f"128-lane head groups: seq_q={sq}, seq_k={sk}, e={e}, h={h}"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if bias is not None:
+        bias = jnp.asarray(bias)
+        if bias.ndim != 2 or bias.shape != (sq, sk):
+            raise ValueError(
+                f"packed kernel takes a shared (sq, sk) bias; got "
+                f"{bias.shape} — use flash_attention for 4-D biases"
+            )
+        if bias.dtype == jnp.bool_:
+            bias = jnp.where(bias, 0.0, NEG_INF).astype(jnp.float32)
+        else:
+            bias = bias.astype(jnp.float32)
+    return _flash(q, k, v, bias, seed, h, float(scale), bool(causal),
+                  int(block_q), int(block_k), bool(interpret), dropout_p,
+                  int(bwd_block))

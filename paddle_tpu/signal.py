@@ -8,6 +8,7 @@ compiler fuses; everything traces/jits/differentiates.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -139,6 +140,34 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
     if win_length != n_fft:
         left = (n_fft - win_length) // 2
         w = jnp.pad(w, (left, n_fft - win_length - left))
+
+    # NOLA check, eager only (the reference raises on violation; clamping
+    # inside the trace would silently distort) — skipped when the window is
+    # a tracer, where istft must stay traceable and the clamp still guards
+    if not isinstance(w, jax.core.Tracer):
+        nf = int(x.shape[-1])
+        fl = int(w.shape[0])
+        out_len = (nf - 1) * hop_length + fl
+        wsq_np = np.asarray(w, dtype=np.float64) ** 2
+        env_np = np.zeros(out_len)
+        for f in range(nf):
+            env_np[f * hop_length: f * hop_length + fl] += wsq_np
+        if center:
+            region = env_np[n_fft // 2: out_len - n_fft // 2]
+        else:
+            # without centering the first/last (fl - hop) samples taper by
+            # construction (partial overlap) — that is not a NOLA violation;
+            # check the steady-state interior only
+            edge = max(fl - hop_length, 0)
+            region = env_np[edge: out_len - edge]
+        if length is not None:
+            region = region[:length]
+        if region.size and region.min() < 1e-11:
+            raise ValueError(
+                "istft: window fails the NOLA (nonzero overlap-add) "
+                f"constraint for hop_length={hop_length} "
+                f"(envelope min {region.min():.3g})"
+            )
 
     def fwd(a, wv):
         if onesided:

@@ -1,0 +1,72 @@
+"""Round-4 advisor-finding regression tests (ADVICE.md round 3):
+DGC applicability warning, SelectedRows demoted-cache accumulate, istft
+NOLA raise, spawn err_q drain."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def test_dgc_non_momentum_warns():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.dgc = True
+    fleet.init(is_collective=True, strategy=strategy)
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=net.parameters())
+    with pytest.warns(UserWarning, match="DGC is NOT applied"):
+        fleet.distributed_optimizer(opt)
+
+
+def test_selected_rows_accumulate_after_dense_demotion():
+    """A dense write (e.g. grad-clip rescale) must survive a subsequent
+    SelectedRows accumulation instead of being discarded."""
+    from paddle_tpu.framework.selected_rows import SelectedRows, SparseGradTensor
+    import jax.numpy as jnp
+
+    sr = SelectedRows(jnp.array([0, 2]), jnp.ones((2, 3)), height=4)
+    g = SparseGradTensor(sr)
+    base = np.asarray(g._value)  # densify
+    g._value = g._value * 10.0   # demoting dense write
+    sr2 = SelectedRows(jnp.array([1]), jnp.ones((1, 3)), height=4)
+    g.accumulate(sr2)
+    want = base * 10.0
+    want[1] += 1.0
+    np.testing.assert_allclose(np.asarray(g._value), want)
+
+
+def test_istft_nola_violation_raises():
+    # a window that is zero over each hop stride can never reconstruct
+    win = np.zeros(64, np.float32)
+    win[0:4] = 1.0
+    x = np.random.RandomState(0).randn(256).astype(np.float32)
+    spec = paddle.signal.stft(Tensor(x), 64, hop_length=32,
+                              window=Tensor(win))
+    with pytest.raises(ValueError, match="NOLA"):
+        paddle.signal.istft(spec, 64, hop_length=32, window=Tensor(win))
+
+
+def test_istft_valid_window_still_works():
+    win = np.hanning(64).astype(np.float32)
+    x = np.random.RandomState(1).randn(256).astype(np.float32)
+    spec = paddle.signal.stft(Tensor(x), 64, hop_length=16,
+                              window=Tensor(win))
+    back = paddle.signal.istft(spec, 64, hop_length=16, window=Tensor(win))
+    assert np.isfinite(back.numpy()).all()
+
+
+def test_spawn_failing_worker_traceback_surfaces():
+    """A worker that dies with a large traceback must not deadlock join;
+    the parent collects and re-raises with the rank's traceback."""
+    from paddle_tpu.distributed.spawn import spawn
+
+    with pytest.raises(RuntimeError, match="workers failed"):
+        spawn(_boom, args=(), nprocs=2, join=True)
+
+
+def _boom():
+    # sizeable traceback payload to stress the queue pipe buffer
+    raise RuntimeError("x" * 100_000)

@@ -106,6 +106,42 @@ def test_grad_matches_finite_difference(wrt):
         assert abs(fd - g[idx]) < 2e-2 + 0.05 * abs(fd), (idx, fd, g[idx])
 
 
+@pytest.mark.parametrize("wrt", [0, 1, 2])
+def test_packed_grad_matches_finite_difference(wrt):
+    """Packed-kernel dropout: fwd and bwd MUST re-tile identically (the
+    PRNG mask depends on tile index and shape) — this FD check fails if
+    bwd_block were allowed to diverge from the forward blocks."""
+    from paddle_tpu.ops.pallas.flash_attention_packed import (
+        flash_attention_packed,
+    )
+
+    b, s, h, d = 1, 256, 2, 64
+    ks = jax.random.split(jax.random.key(11), 3)
+    args = [jax.random.normal(k_, (b, s, h * d), jnp.float32) for k_ in ks]
+    seed = jnp.array([55, 66], jnp.int32)
+    co = jax.random.normal(jax.random.key(4), args[0].shape, jnp.float32)
+
+    def f(*a):
+        out = flash_attention_packed(
+            a[0], a[1], a[2], h, causal=True, dropout_p=0.25,
+            dropout_seed=seed, block_q=256, block_k=256, bwd_block=128,
+            interpret=False)
+        return jnp.vdot(out, co)
+
+    g = np.asarray(jax.grad(f, argnums=wrt)(*args))
+    rng = np.random.RandomState(1)
+    x = np.asarray(args[wrt])
+    eps = 1e-2
+    for _ in range(6):
+        idx = tuple(rng.randint(0, dim) for dim in x.shape)
+        e = np.zeros_like(x)
+        e[idx] = eps
+        hi = [a if i != wrt else jnp.asarray(x + e) for i, a in enumerate(args)]
+        lo = [a if i != wrt else jnp.asarray(x - e) for i, a in enumerate(args)]
+        fd = (float(f(*hi)) - float(f(*lo))) / (2 * eps)
+        assert abs(fd - g[idx]) < 2e-2 + 0.05 * abs(fd), (idx, fd, g[idx])
+
+
 def test_sdpa_router_keeps_flash_with_dropout():
     """F.scaled_dot_product_attention with dropout>0 must stay on the flash
     path on a compiled TPU backend (round-3 VERDICT weak #2)."""
