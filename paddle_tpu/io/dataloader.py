@@ -6,12 +6,14 @@ shared-memory tensors. TPU-native redesign:
 
  - workers produce *numpy host batches* (device transfer happens once, at the
    jit boundary, or explicitly via to_tensor) — so the worker pool never
-   touches jax/TPU state and can be plain threads or processes;
- - the default path uses a thread pool + bounded prefetch queue (GIL impact is
-   small because decode/augment is numpy C code); `num_workers>0` with
-   `use_process=True` uses a multiprocessing pool like the reference;
- - a C++ shared-ring buffer backend (paddle_tpu/csrc) replaces the
-   reference's mmap shared-memory channel for zero-copy IPC when built.
+   touches jax/TPU state and can be threads or processes;
+ - the default path uses a thread pool + bounded prefetch queue (GIL impact
+   is small while decode/augment is numpy C code);
+ - ``use_process=True`` with ``num_workers>0`` runs forked worker PROCESSES
+   with shared-memory batch transport (``io/worker.py`` — the reference's
+   ``_DataLoaderIterMultiProcess`` + mmap channel), the right choice for
+   Python-heavy per-sample transforms; ``persistent_workers=True`` keeps
+   the pool alive across epochs.
 """
 from __future__ import annotations
 
@@ -68,6 +70,7 @@ class DataLoader:
         timeout=0,
         worker_init_fn=None,
         persistent_workers=False,
+        use_process=False,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -75,6 +78,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self.worker_init_fn = worker_init_fn
+        self.use_process = bool(use_process)
+        self.use_shared_memory = bool(use_shared_memory)
+        self.persistent_workers = bool(persistent_workers)
+        self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -178,10 +185,45 @@ class DataLoader:
                     # shutdown, when threading internals are already gone
                     pass
 
+    def _iter_process(self):
+        """Forked worker processes + shared-memory transport (io/worker.py)."""
+        from .worker import ProcessPool
+
+        iterable_cfg = ((self.batch_size, self.drop_last)
+                        if self._iterable_mode else None)
+        pool = self._pool
+        if pool is None:
+            pool = ProcessPool(self, iterable_cfg)
+            if self.persistent_workers:
+                self._pool = pool
+        try:
+            if self._iterable_mode:
+                yield from pool.run_iterable_epoch()
+            else:
+                batches = list(self.batch_sampler)
+                capacity = max(2, self.num_workers * self.prefetch_factor)
+                yield from pool.run_epoch(batches, capacity)
+        finally:
+            if pool is not self._pool:
+                pool.shutdown()
+
     def __iter__(self):
-        it = self._iter_single() if self.num_workers == 0 else self._iter_threaded()
+        if self.num_workers == 0:
+            it = self._iter_single()
+        elif self.use_process:
+            it = self._iter_process()
+        else:
+            it = self._iter_threaded()
         for batch in it:
             yield batch
+
+    def __del__(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            try:
+                pool.shutdown()
+            except Exception:
+                pass
 
     @staticmethod
     def from_generator(*args, **kwargs):

@@ -2,31 +2,48 @@
 
 TPU-native export story: the portable artifact is StableHLO via
 ``paddle.jit.save`` (jit/save_load.py), which MLIR-consuming toolchains
-ingest directly. ``export`` performs that export at the requested path; an
-actual ``.onnx`` conversion additionally requires the optional
-``paddle2onnx``/``onnx`` packages (not present in this environment), and
-raises a clear error for that step only.
+ingest directly.  An actual ``.onnx`` conversion requires the
+``paddle2onnx``/``onnx`` packages, which are not available in this
+offline environment — so ``export`` RAISES for the default onnx format
+(never a silent warning that leaves the named artifact unwritten) and
+performs the StableHLO export only on explicit opt-in
+(``format_="stablehlo"``).
 """
 from __future__ import annotations
-
-import warnings
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Exports ``layer`` as StableHLO + weights at ``path`` (always), then
-    attempts the ONNX conversion when the onnx package is available."""
-    from ..jit.save_load import save as jit_save
+def export(layer, path, input_spec=None, opset_version=9, *,
+           format_="onnx", **configs):
+    """Export ``layer``.
 
-    jit_save(layer, path, input_spec=input_spec)
+    ``format_="stablehlo"``: writes StableHLO + weights at ``path``
+    (``.pdmodel``/``.pdiparams``, loadable by ``paddle.jit.load`` and any
+    MLIR toolchain) and returns the path.
+
+    ``format_="onnx"`` (default, reference signature): requires the
+    ``onnx`` package for the conversion step; unavailable here, so this
+    raises rather than pretending the ``.onnx`` artifact exists.
+    """
+    if format_ == "stablehlo":
+        from ..jit.save_load import save as jit_save
+
+        jit_save(layer, path, input_spec=input_spec)
+        return path
+    if format_ != "onnx":
+        raise ValueError(f"unknown export format {format_!r}")
     try:
         import onnx  # noqa: F401
-
-        detail = ("the StableHLO->ONNX conversion step is not wired yet")
     except ImportError:
-        detail = "onnx is not installed"
-    warnings.warn(
-        f"exported StableHLO + weights at {path!r} (.pdmodel/.pdiparams); "
-        f"no .onnx file was written ({detail})", stacklevel=2)
-    return path
+        raise RuntimeError(
+            "paddle.onnx.export cannot produce a .onnx file: the 'onnx' "
+            "package is not installed in this environment. Use "
+            "export(..., format_='stablehlo') for the portable StableHLO "
+            "artifact (paddle.jit.save format), or install onnx/paddle2onnx."
+        ) from None
+    raise RuntimeError(
+        "paddle.onnx.export: the StableHLO->ONNX conversion step is not "
+        "implemented; use export(..., format_='stablehlo') for the portable "
+        "StableHLO artifact instead"
+    )

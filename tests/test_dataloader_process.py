@@ -1,0 +1,194 @@
+"""Multiprocess DataLoader (io/worker.py): parity with in-process loading,
+shared-memory transport, persistent workers, error/crash propagation.
+Reference: ``fluid/dataloader/dataloader_iter.py:342``
+(_DataLoaderIterMultiProcess) + ``memory/allocation/mmap_allocator.cc``."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset, IterableDataset, get_worker_info
+from paddle_tpu.io.worker import WorkerFailure
+
+
+@pytest.fixture(autouse=True)
+def _fast_fork(monkeypatch, request):
+    """fork-start for speed (forkserver costs ~10s/pool on this box); the
+    default forkserver path is exercised by test_forkserver_default_start."""
+    if "forkserver" not in request.node.name:
+        monkeypatch.setenv("PADDLE_TPU_WORKER_START", "fork")
+
+
+def test_forkserver_default_start():
+    ds = ArrayDataset()
+    assert os.environ.get("PADDLE_TPU_WORKER_START") is None
+    got = _collect(DataLoader(ds, batch_size=16, num_workers=2,
+                              use_process=True))
+    assert got == list(range(64))
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, dim=8):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(i)
+
+
+class PyHeavyDataset(ArrayDataset):
+    """Pure-Python per-sample transform: the GIL-bound case processes exist
+    for."""
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for j in range(20000):
+            acc += (i * j) % 7
+        x, y = super().__getitem__(i)
+        return x + (acc % 3), y
+
+
+class BoomDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("boom at 13")
+        return super().__getitem__(i)
+
+
+class KillSelfDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 7:
+            os._exit(42)  # simulates a segfaulted/killed worker
+        return super().__getitem__(i)
+
+
+class ShardedIterable(IterableDataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __iter__(self):
+        info = get_worker_info()
+        wid = info.id if info else 0
+        nw = info.num_workers if info else 1
+        for i in range(wid, self.n, nw):
+            yield np.float32(i)
+
+
+def _collect(loader):
+    out = []
+    for xb, yb in loader:
+        out.extend(np.asarray(yb).tolist())
+        assert np.asarray(xb).dtype == np.float32
+    return out
+
+
+def test_process_mode_parity_with_single_thread():
+    ds = ArrayDataset()
+    base = _collect(DataLoader(ds, batch_size=8, num_workers=0))
+    got = _collect(DataLoader(ds, batch_size=8, num_workers=4,
+                              use_process=True))
+    assert got == base
+    # batches themselves identical
+    b0 = next(iter(DataLoader(ds, batch_size=8, num_workers=0)))
+    b1 = next(iter(DataLoader(ds, batch_size=8, num_workers=4,
+                              use_process=True)))
+    np.testing.assert_array_equal(np.asarray(b0[0]), np.asarray(b1[0]))
+
+
+def test_process_mode_without_shared_memory():
+    ds = ArrayDataset()
+    base = _collect(DataLoader(ds, batch_size=8, num_workers=0))
+    got = _collect(DataLoader(ds, batch_size=8, num_workers=2,
+                              use_process=True, use_shared_memory=False))
+    assert got == base
+
+
+def test_worker_exception_propagates():
+    loader = DataLoader(BoomDataset(), batch_size=4, num_workers=2,
+                        use_process=True)
+    with pytest.raises(WorkerFailure, match="boom at 13"):
+        list(loader)
+
+
+def test_killed_worker_detected():
+    loader = DataLoader(KillSelfDataset(), batch_size=4, num_workers=2,
+                        use_process=True)
+    with pytest.raises(WorkerFailure, match="exited unexpectedly"):
+        list(loader)
+
+
+def test_persistent_workers_reuse_pool_across_epochs():
+    ds = ArrayDataset()
+    loader = DataLoader(ds, batch_size=8, num_workers=2, use_process=True,
+                        persistent_workers=True)
+    e1 = _collect(loader)
+    pool = loader._pool
+    assert pool is not None
+    pids = [p.pid for p in pool._procs]
+    e2 = _collect(loader)
+    assert e1 == e2
+    assert loader._pool is pool
+    assert [p.pid for p in pool._procs] == pids
+    assert all(p.is_alive() for p in pool._procs)
+    loader.__del__()
+    assert all(not p.is_alive() for p in pool._procs)
+
+
+def test_early_break_then_reiterate():
+    ds = ArrayDataset()
+    loader = DataLoader(ds, batch_size=8, num_workers=2, use_process=True,
+                        persistent_workers=True)
+    it = iter(loader)
+    next(it), next(it)  # abandon mid-epoch
+    del it
+    assert _collect(loader) == list(range(64))  # stale epoch fully discarded
+
+
+def test_iterable_dataset_process_sharding():
+    loader = DataLoader(ShardedIterable(48), batch_size=4, num_workers=3,
+                        use_process=True)
+    got = []
+    for batch in loader:
+        got.extend(np.asarray(batch).astype(int).tolist())
+    assert sorted(got) == list(range(48))
+
+
+def _ok_init(wid):
+    pass  # runs in the child
+
+
+def _bad_init(wid):
+    raise RuntimeError("init failed")
+
+
+def test_worker_init_fn_runs_and_failure_propagates():
+    ds = ArrayDataset()
+    assert _collect(DataLoader(ds, batch_size=8, num_workers=2,
+                               use_process=True, worker_init_fn=_ok_init)) \
+        == list(range(64))
+
+    loader = DataLoader(ds, batch_size=8, num_workers=2, use_process=True,
+                        worker_init_fn=_bad_init)
+    with pytest.raises(WorkerFailure, match="worker_init_fn"):
+        list(loader)
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="parallel speedup needs >1 core")
+def test_python_heavy_transform_speedup():
+    """The reason process workers exist: a pure-Python transform is GIL-bound
+    under threads but parallel under processes."""
+    ds = PyHeavyDataset(n=32)
+
+    t0 = time.perf_counter()
+    _collect(DataLoader(ds, batch_size=4, num_workers=4))
+    threaded = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    _collect(DataLoader(ds, batch_size=4, num_workers=4, use_process=True))
+    proc_time = time.perf_counter() - t0
+
+    assert proc_time < threaded, (proc_time, threaded)
