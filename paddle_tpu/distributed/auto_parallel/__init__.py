@@ -11,7 +11,8 @@ the whole pipeline after that is the compiler. ``Engine`` is the same
 user surface (prepare/fit/evaluate/predict) driving one jitted SPMD step.
 """
 from .process_mesh import ProcessMesh  # noqa: F401
-from .interface import shard_tensor, shard_op  # noqa: F401
+from .interface import shard_tensor, shard_op, reshard, dtensor_from_fn  # noqa: F401
 from .engine import Engine  # noqa: F401
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "reshard",
+           "dtensor_from_fn", "Engine"]

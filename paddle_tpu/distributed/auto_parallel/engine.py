@@ -39,8 +39,68 @@ class Engine:
             n = len(jax.devices())
             pm = ProcessMesh(np.arange(n), dim_names=["dp"])
         self._pm = pm
+        # cluster (reference auto_parallel/cluster.py): on TPU the device
+        # topology is jax's; a provided cluster bounds the usable device set
+        n_avail = len(jax.devices())
+        n_cluster = getattr(cluster, "device_count", None)
+        if callable(n_cluster):
+            n_cluster = n_cluster()
+        if n_cluster is not None:
+            n_avail = min(n_avail, int(n_cluster))
+        ids = np.asarray(pm.processes)
+        if ids.size and int(ids.max()) >= n_avail:
+            raise ValueError(
+                f"process_mesh uses device id {int(ids.max())} but only "
+                f"{n_avail} devices are available"
+                + (" (bounded by cluster)" if n_cluster is not None else ""))
         self._train_step = None
         self._eval_step = None
+        self._strategy_applied = False
+
+    # -- strategy ------------------------------------------------------------
+    def _apply_strategy(self):
+        """Consume the fleet.DistributedStrategy (reference engine.py
+        passes it through parallelizer passes; here each enabled feature
+        maps to its TPU-native mechanism): amp -> auto_cast around the
+        step; sharding -> ZeRO placement over the mesh's first dim;
+        gradient_merge -> in-step micro-batch accumulation (k fwd/bwd, one
+        optimizer step)."""
+        strat = self.strategy
+        if strat is None or self._strategy_applied:
+            return
+        self._strategy_applied = True
+        if getattr(strat, "sharding", False) and self._optimizer is not None:
+            from ..collective import Group
+            from ..sharding import group_sharded_parallel
+
+            stage = int(strat.sharding_configs.get("stage", 1))
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage, "os")
+            g = Group(self._pm.jax_mesh, self._pm.dim_names[0], gid=0)
+            self.model, self._optimizer, _ = group_sharded_parallel(
+                self.model, self._optimizer, level=level, group=g)
+
+    def _amp_ctx(self):
+        strat = self.strategy
+        if strat is None or not getattr(strat, "amp", False):
+            import contextlib
+
+            return contextlib.nullcontext()
+        from ... import amp as amp_mod
+
+        cfg = strat.amp_configs
+        return amp_mod.auto_cast(
+            enable=True,
+            custom_white_list=cfg.get("custom_white_list") or None,
+            custom_black_list=cfg.get("custom_black_list") or None,
+            level=("O2" if cfg.get("use_pure_fp16") else "O1"),
+            dtype="bfloat16" if cfg.get("use_bf16", True) else "float16",
+        )
+
+    def _merge_k(self):
+        strat = self.strategy
+        if strat is None or not getattr(strat, "gradient_merge", False):
+            return 1
+        return max(1, int(strat.gradient_merge_configs.get("k_steps", 1)))
 
     # -- data placement ------------------------------------------------------
     def _shard_batch(self, arr):
@@ -67,14 +127,36 @@ class Engine:
         if self._train_step is None:
             from ...jit.functionalize import CompiledStep
 
+            self._apply_strategy()
             model, loss_fn, opt = self.model, self._loss, self._optimizer
             self._replicate_params()
+            k = self._merge_k()
+            amp_ctx = self._amp_ctx
 
-            def step(x, y):
-                out = model(x)
-                loss = loss_fn(out, y)
+            def one(x, y):
+                with amp_ctx():
+                    out = model(x)
+                    loss = loss_fn(out, y)
                 loss = loss.mean() if loss.ndim > 0 else loss
                 loss.backward()
+                return loss, out
+
+            def step(x, y):
+                if k == 1:
+                    loss, out = one(x, y)
+                else:
+                    # gradient merge: k micro fwd/bwd accumulate into the
+                    # param grads, then ONE optimizer step (reference
+                    # gradient_merge pass; avg per configs)
+                    losses = []
+                    for xc, yc in zip(x.chunk(k, axis=0), y.chunk(k, axis=0)):
+                        li, out = one(xc, yc)
+                        losses.append(li)
+                    loss = sum(losses) / float(len(losses))
+                    if self.strategy.gradient_merge_configs.get("avg", True):
+                        for p in model.parameters():
+                            if p.grad is not None:
+                                p.grad._value = p.grad._value / float(k)
                 opt.step()
                 opt.clear_grad()
                 return loss, out

@@ -12,7 +12,7 @@ from ...framework.tensor import Tensor
 from ...ops.dispatch import apply_op
 from .process_mesh import ProcessMesh, get_current_process_mesh
 
-__all__ = ["shard_tensor", "shard_op"]
+__all__ = ["shard_tensor", "shard_op", "reshard", "dtensor_from_fn"]
 
 
 def _sharding_from(dist_attr):
@@ -49,8 +49,45 @@ def _sharding_from(dist_attr):
 
 def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None):
     """Annotate ``x``'s placement. Accepts the reference dict form
-    ``{"process_mesh": pm, "dims_mapping": [0, -1]}`` or the keyword form."""
+    ``{"process_mesh": pm, "dims_mapping": [0, -1]}`` or the keyword form.
+
+    Like the reference (which attaches a dist_attr to the SAME var), an
+    eager Tensor/Parameter is annotated IN PLACE — ``shard_tensor(w, ...)``
+    on a layer's registered parameter leaves the layer holding the
+    annotated param, which the Engine preserves through training. Traced
+    values get a sharding constraint through the op graph instead."""
     if dist_attr is None and (process_mesh is not None or shard_spec is not None):
+        dist_attr = {"process_mesh": process_mesh, "dims_mapping": shard_spec}
+    sh = _sharding_from(dist_attr)
+
+    # in-place only for concrete arrays: Tracers (jit) need the constraint
+    # op and static Variables (whose _value is a ShapeDtypeStruct) must
+    # RECORD through apply_op
+    if (isinstance(x, Tensor) and isinstance(x._value, jax.Array)
+            and not isinstance(x._value, jax.core.Tracer)):
+        x._value = jax.device_put(x._value, sh)
+        return x
+
+    def fwd(v):
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, sh)
+        return jax.device_put(v, sh)
+
+    return apply_op("shard_tensor", fwd, (x,), {})
+
+
+def reshard(x, process_mesh=None, shard_spec=None, dist_attr=None):
+    """Cross-mesh / cross-placement transfer (reference
+    ``auto_parallel/reshard.py`` — the Resharder pass inserting
+    send/recv + slice/concat between different dist_attrs).
+
+    TPU-native: a reshard IS a ``jax.device_put`` onto the target
+    ``NamedSharding`` — XLA's runtime performs the all-gather / slice /
+    device-to-device moves the reference hand-codes, including between
+    DIFFERENT meshes (device sets), which GSPMD-in-jit alone cannot do.
+    Works eagerly; inside a jit trace the target mesh must equal the
+    current mesh (then it lowers to a sharding constraint)."""
+    if dist_attr is None:
         dist_attr = {"process_mesh": process_mesh, "dims_mapping": shard_spec}
     sh = _sharding_from(dist_attr)
 
@@ -59,7 +96,13 @@ def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None):
             return jax.lax.with_sharding_constraint(v, sh)
         return jax.device_put(v, sh)
 
-    return apply_op("shard_tensor", fwd, (x,), {})
+    return apply_op("reshard", fwd, (x,), {})
+
+
+def dtensor_from_fn(fn, process_mesh=None, shard_spec=None, *args, **kwargs):
+    """Reference ``dtensor_from_fn``: build a tensor with a placement."""
+    return shard_tensor(fn(*args, **kwargs), process_mesh=process_mesh,
+                        shard_spec=shard_spec)
 
 
 def shard_op(op_fn, dist_attr=None, in_dims_mappings=None,

@@ -233,6 +233,11 @@ def _mp_axis_reduce(op, stacked):
     raise ValueError(f"unknown ReduceOp {op}")
 
 
+def _op_suffix(op):
+    return {ReduceOp.SUM: "sum", ReduceOp.MAX: "max", ReduceOp.MIN: "min",
+            ReduceOp.PROD: "prod", ReduceOp.AVG: "avg"}.get(op, "sum")
+
+
 def _reduce_fn(op, axis):
     if op == ReduceOp.SUM:
         return lambda x: lax.psum(x, axis)
@@ -258,6 +263,41 @@ def _ret(tensor, val):
     return Tensor(val)
 
 
+def _record_static(opname, g, per_shard_fn, tensor, in_specs=None,
+                   out_specs=None):
+    """Record the collective into the active static Program.
+
+    Reference: the ``c_*`` collective op set appended to a BlockDesc
+    (``operators/collective/c_allreduce_op.h:364``) so a serialized static
+    Program can carry and replay communication — SURVEY §7's last hard
+    part.  Here the recorded fwd is the same one-op ``shard_map`` the eager
+    path runs; the Executor replays it under its jit (and
+    ``save_inference_model`` serializes it into the StableHLO artifact,
+    collectives included).  Returns the output Variable, or None when not
+    recording / ``tensor`` is not symbolic."""
+    from ..ops import dispatch
+
+    if dispatch.STATIC_RECORDER is None:
+        return None
+    from ..static.program import Variable
+
+    if not isinstance(tensor, Variable):
+        return None
+    ins = in_specs if in_specs is not None else P(g.axis_name)
+    outs = out_specs if out_specs is not None else P(g.axis_name)
+
+    def fwd(x):
+        if g.nranks == 1:
+            one = Mesh(np.array(jax.devices()[:1]),
+                       axis_names=(g.axis_name,))
+            return shard_map(per_shard_fn, mesh=one, in_specs=(P(),),
+                             out_specs=P(), check_vma=False)(x)
+        return shard_map(per_shard_fn, mesh=g.mesh, in_specs=(ins,),
+                         out_specs=outs, check_vma=False)(x)
+
+    return dispatch.apply_op(opname, fwd, (tensor,), {})
+
+
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
@@ -271,6 +311,9 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """
     g = group or _default_group()
     body = _reduce_fn(op, g.axis_name)
+    rec = _record_static(f"c_allreduce_{_op_suffix(op)}", g, body, tensor)
+    if rec is not None:
+        return tensor._rebind(rec)
     if _in_spmd(g.axis_name):
         return _ret(tensor, body(_unwrap(tensor)))
     x = _unwrap(tensor)
@@ -297,6 +340,14 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     g = group or _default_group()
     if tensor is None and not isinstance(tensor_list, (list,)):
         tensor, tensor_list = tensor_list, None
+    if tensor_list is None:
+        # stacked-global eager convention: the global array already IS the
+        # gather — record the identity so the Program carries the op
+        rec = _record_static("c_allgather", g, lambda x: x, tensor,
+                             in_specs=P(g.axis_name),
+                             out_specs=P(g.axis_name))
+        if rec is not None:
+            return rec
     x = _unwrap(tensor)
     if _in_spmd(g.axis_name):
         out = lax.all_gather(x, g.axis_name, tiled=True)
@@ -343,6 +394,9 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         idx = lax.axis_index(g.axis_name)
         return jnp.where(idx == dst, r, x)
 
+    rec = _record_static(f"c_reduce_{_op_suffix(op)}", g, per_shard, tensor)
+    if rec is not None:
+        return tensor._rebind(rec)
     if _in_spmd(g.axis_name):
         return _ret(tensor, per_shard(_unwrap(tensor)))
     return _ret(tensor, _apply(tensor, g, per_shard))
@@ -358,6 +412,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         contrib = jnp.where(idx == src, x, jnp.zeros_like(x))
         return lax.psum(contrib, g.axis_name)
 
+    rec = _record_static("c_broadcast", g, per_shard, tensor)
+    if rec is not None:
+        return tensor._rebind(rec)
     if _in_spmd(g.axis_name):
         return _ret(tensor, per_shard(_unwrap(tensor)))
     xv = _unwrap(tensor)
@@ -403,6 +460,13 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_o
         # stacked-global convention: row i = rank i's received piece
         return _ret(tensor, out)
 
+    rec = _record_static(
+        "c_reducescatter", g,
+        lambda x: lax.psum_scatter(x[0], g.axis_name, scatter_dimension=0,
+                                   tiled=True)[None],
+        tensor)
+    if rec is not None:
+        return tensor._rebind(rec)
     inp = _unwrap(tensor)
 
     def per_shard(x):

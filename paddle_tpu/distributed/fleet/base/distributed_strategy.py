@@ -45,6 +45,7 @@ class DistributedStrategy:
             pp_degree=1,
             sharding_degree=1,
             sep_degree=1,
+            dcn_degree=1,
             mp_configs=_ConfigDict(sync_param=False, sync_grad=False, sync_moment=False),
             # empty by default: pipeline_configs holds the defaults; entries
             # set here override it (PipelineParallel reads both)

@@ -36,6 +36,7 @@ class Fleet:
             pp_degree=hc["pp_degree"],
             sharding_degree=hc["sharding_degree"],
             sep_degree=hc.get("sep_degree", 1),
+            dcn_degree=hc.get("dcn_degree", 1),
         )
         _hcg = self._hcg
         self._is_initialized = True

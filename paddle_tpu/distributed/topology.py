@@ -86,21 +86,25 @@ AXIS_PP = "pp"        # "pipe"
 AXIS_SHARD = "sharding"
 AXIS_MP = "mp"        # "model" (tensor parallel)
 AXIS_SEP = "sep"      # sequence/context parallel — green-field (SURVEY §5)
+AXIS_DCN = "dcn"      # cross-slice / cross-node data parallelism over DCN
 
 
 class HybridCommunicateGroup:
     """reference ``topology.py:134``. Builds the global Mesh for a 4-D (±sep)
     hybrid strategy and hands out per-axis Groups.
 
-    Mesh axis order is (pp, dp, sharding, sep, mp): pp outermost (lowest
-    bandwidth need — can cross DCN), mp innermost (highest bandwidth —
-    stays on ICI neighbors). Size-1 axes are kept in the mesh (harmless to
-    XLA) so the axis names are always valid.
+    Mesh axis order is (dcn, pp, dp, sharding, sep, mp): dcn outermost —
+    its device blocks are whole slices/hosts, so the only traffic crossing
+    the data-center network is the dcn-axis collective (the classic
+    multi-slice recipe: DP over DCN, everything else on ICI); then pp
+    (lowest ICI bandwidth need), mp innermost (highest bandwidth — stays
+    on ICI neighbors). Size-1 axes are kept in the mesh (harmless to XLA)
+    so the axis names are always valid.
     """
 
     def __init__(self, topology: CommunicateTopology | None = None, *,
                  dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
-                 sep_degree=1):
+                 sep_degree=1, dcn_degree=1):
         if topology is not None:
             names = topology.get_hybrid_group_names()
             get = lambda n: topology.get_dim(n) if n in names else 1
@@ -109,24 +113,30 @@ class HybridCommunicateGroup:
             sharding_degree = get("sharding")
             mp_degree = get("model")
             sep_degree = get("sep")
+            dcn_degree = get("dcn")
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
+        self._dcn_degree = dcn_degree
 
-        n = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        n = (dp_degree * mp_degree * pp_degree * sharding_degree
+             * sep_degree * dcn_degree)
         devs = jax.devices()
         if n > len(devs):
             raise ValueError(
                 f"hybrid strategy needs {n} devices "
-                f"(dp{dp_degree}×pp{pp_degree}×sharding{sharding_degree}"
+                f"(dcn{dcn_degree}×dp{dp_degree}×pp{pp_degree}"
+                f"×sharding{sharding_degree}"
                 f"×sep{sep_degree}×mp{mp_degree}), have {len(devs)}"
             )
         arr = np.array(devs[:n]).reshape(
-            pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree
+            dcn_degree, pp_degree, dp_degree, sharding_degree, sep_degree,
+            mp_degree
         )
-        self.mesh = Mesh(arr, axis_names=(AXIS_PP, AXIS_DP, AXIS_SHARD, AXIS_SEP, AXIS_MP))
+        self.mesh = Mesh(arr, axis_names=(
+            AXIS_DCN, AXIS_PP, AXIS_DP, AXIS_SHARD, AXIS_SEP, AXIS_MP))
         mesh_mod.set_mesh(self.mesh)
 
         self._dp_group = Group(self.mesh, AXIS_DP)
@@ -134,6 +144,7 @@ class HybridCommunicateGroup:
         self._pp_group = Group(self.mesh, AXIS_PP)
         self._sharding_group = Group(self.mesh, AXIS_SHARD)
         self._sep_group = Group(self.mesh, AXIS_SEP)
+        self._dcn_group = Group(self.mesh, AXIS_DCN)
         self.global_rank = 0
 
     # -- degrees (reference topology.py:141-144) ----------------------------
@@ -151,6 +162,12 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_world_size(self):
         return self._sep_degree
+
+    def get_dcn_parallel_world_size(self):
+        return self._dcn_degree
+
+    def get_dcn_parallel_group(self):
+        return self._dcn_group
 
     # -- parallel mode resolution (reference topology.py:198-205) -----------
     def _check_vaild_topo(self):

@@ -81,6 +81,7 @@ class DataLoader:
         self.use_process = bool(use_process)
         self.use_shared_memory = bool(use_shared_memory)
         self.persistent_workers = bool(persistent_workers)
+        self.timeout = timeout
         self._pool = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -192,10 +193,14 @@ class DataLoader:
         iterable_cfg = ((self.batch_size, self.drop_last)
                         if self._iterable_mode else None)
         pool = self._pool
-        if pool is None:
+        # a persistent pool serves ONE live iterator; concurrent iterators
+        # would cross epoch tags (each discarding the other's batches as
+        # stale) — the overlapping iterator gets its own temporary pool
+        if pool is None or pool._busy:
             pool = ProcessPool(self, iterable_cfg)
-            if self.persistent_workers:
+            if self.persistent_workers and self._pool is None:
                 self._pool = pool
+        pool._busy = True
         try:
             if self._iterable_mode:
                 yield from pool.run_iterable_epoch()
@@ -204,6 +209,7 @@ class DataLoader:
                 capacity = max(2, self.num_workers * self.prefetch_factor)
                 yield from pool.run_epoch(batches, capacity)
         finally:
+            pool._busy = False
             if pool is not self._pool:
                 pool.shutdown()
 

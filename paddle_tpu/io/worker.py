@@ -223,6 +223,7 @@ class ProcessPool:
         self._capacity = max(2, self._nw * loader.prefetch_factor)
         self._result_q = ctx.Queue(maxsize=self._capacity + self._nw)
         self._epoch = 0
+        self._busy = False   # one live iterator at a time (epoch tags)
         base_seed = int.from_bytes(os.urandom(4), "little")
         self._procs = [
             ctx.Process(

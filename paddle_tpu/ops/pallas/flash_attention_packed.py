@@ -67,17 +67,29 @@ def _group_width(d):
     return (LANES // d, LANES) if LANES % d == 0 else (0, 0)
 
 
-def _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale, causal,
-                 block_q, block_k, offset):
+def _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal):
+    """Per-tile additive term, computed ONCE per kernel instance and shared
+    by every head in the group (the causal iota pair costs real VPU time —
+    paying it per head doubled the masking work at d=64)."""
+    add = None if b_ref is None else b_ref[...].astype(jnp.float32)
+    if causal:
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        neg = jnp.where(cols <= rows + offset, 0.0, NEG_INF)
+        add = neg if add is None else add + neg
+    return add
+
+
+def _head_logits(q_ref, k_ref, add, j, d, scale):
     qh = q_ref[0, :, j * d:(j + 1) * d]
     kh = k_ref[0, :, j * d:(j + 1) * d]
     s = jax.lax.dot_general(
         qh, kh, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
     ) * scale
-    if b_ref is not None:
-        s = s + b_ref[...].astype(jnp.float32)
-    if causal:
-        s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+    if add is not None:
+        s = s + add
     return s
 
 
@@ -89,9 +101,37 @@ def _drop(seed_ref, j, hpg, qi, ki, shape, dropout_p):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, hpg, d, scale, causal, block_q,
-                block_k, offset, dropout_p):
+                block_k, offset, dropout_p, single):
     qi, ki = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
+
+    if single:
+        # nk == 1 (whole key range in one tile): plain softmax — no online
+        # rescale, no m/l scratch round-trips, no acc rescale multiply
+        add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
+        for j in range(hpg):
+            s = _head_logits(q_ref, k_ref, add, j, d, scale)
+            m = jnp.max(s, axis=-1, keepdims=True)
+            p = jnp.exp(s - m)
+            # a fully-masked q row (causal with sq > sk) has m == NEG_INF
+            # and would see p = exp(0) = 1 everywhere; zero it so the
+            # output is 0 and lse stays NEG_INF (matching the multi-tile
+            # path's @pl.when(run) skip)
+            p = jnp.where(m > NEG_INF * 0.5, p, 0.0)
+            l = jnp.sum(p, axis=-1, keepdims=True)
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            if dropout_p > 0.0:
+                keep = _drop(seed_ref, j, hpg, qi, ki, s.shape, dropout_p)
+                p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+            vh = v_ref[0, :, j * d:(j + 1) * d]
+            o_ref[0, :, j * d:(j + 1) * d] = (jax.lax.dot_general(
+                p.astype(vh.dtype), vh,
+                (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            ) / l_safe).astype(o_ref.dtype)
+            if lse_ref is not None:
+                lse_ref[0, j] = jnp.broadcast_to(
+                    m + jnp.log(l_safe), lse_ref.shape[2:])
+        return
 
     @pl.when(ki == 0)
     def _init():
@@ -103,9 +143,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _body():
+        add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
         for j in range(hpg):
-            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
-                             causal, block_q, block_k, offset)
+            s = _head_logits(q_ref, k_ref, add, j, d, scale)
             m_prev = m_ref[j][:, 0:1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
@@ -155,9 +195,9 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
 
     @pl.when(run)
     def _body():
+        add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
         for j in range(hpg):
-            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
-                             causal, block_q, block_k, offset)
+            s = _head_logits(q_ref, k_ref, add, j, d, scale)
             p = jnp.exp(s - lse_ref[0, j][:, 0:1])
             doh = do_ref[0, :, j * d:(j + 1) * d]
             oh = o_ref[0, :, j * d:(j + 1) * d]
@@ -203,9 +243,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
 
     @pl.when(run)
     def _body():
+        add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
         for j in range(hpg):
-            s = _head_logits(q_ref, k_ref, b_ref, j, d, qi, ki, scale,
-                             causal, block_q, block_k, offset)
+            s = _head_logits(q_ref, k_ref, add, j, d, scale)
             p = jnp.exp(s - lse_ref[0, j][:, 0:1])
             doh = do_ref[0, :, j * d:(j + 1) * d]
             oh = o_ref[0, :, j * d:(j + 1) * d]
@@ -303,6 +343,7 @@ def _fwd_impl(q, k, v, bias, seed, h, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
+        single=(nk == 1),
     )
     # full kernel signature: (seed, q, k, v, bias, o, lse, <scratch>)
     missing = ([0] if seed is None else []) + ([4] if bias is None else [])

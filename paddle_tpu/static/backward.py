@@ -51,7 +51,9 @@ def _grad_env(program, feed_env):
         for p, v in zip(params, param_vals):
             p._value = v
         try:
-            env = _replay(program, dict(feed_env))
+            # deferred=[] skips grad-consuming ops (e.g. recorded grad-sync
+            # collectives) — they are downstream of the loss by construction
+            env = _replay(program, dict(feed_env), deferred=[])
             return env[loss_var.name]
         finally:
             for p, v in zip(params, old):

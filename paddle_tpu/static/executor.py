@@ -24,21 +24,46 @@ def global_scope():
     return _SCOPE
 
 
-def _replay(program, env, upto=None):
-    """Run the tape on concrete/traced arrays. ``env``: Variable name -> array."""
-    for node in program.ops if upto is None else program.ops[:upto]:
-        vals = []
-        for a, nm in zip(node.args, node.arg_names):
-            if nm is not None:
-                vals.append(env[nm])
-            elif isinstance(a, Tensor):
-                vals.append(a._value)
-            else:
-                vals.append(a)
-        out = node.fwd(*vals, **node.kwargs)
-        outs = list(out) if isinstance(out, (tuple, list)) else [out]
-        for nm, o in zip(node.out_names, outs):
-            env[nm] = o
+def _run_node(node, env):
+    vals = []
+    for a, nm in zip(node.args, node.arg_names):
+        if nm is not None:
+            vals.append(env[nm])
+        elif isinstance(a, Tensor):
+            vals.append(a._value)
+        else:
+            vals.append(a)
+    out = node.fwd(*vals, **node.kwargs)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    for nm, o in zip(node.out_names, outs):
+        env[nm] = o
+
+
+def _replay(program, env, upto=None, deferred=None):
+    """Run the tape on concrete/traced arrays. ``env``: Variable name -> array.
+
+    ``deferred`` (a list): ops whose inputs are not yet in ``env`` — e.g. a
+    DP grad-sync collective recorded on an ``@GRAD`` Variable, which only
+    materializes after the grad pass — are appended there (with everything
+    downstream of them) instead of raising; the Executor replays them after
+    merging the grad env."""
+    nodes = program.ops if upto is None else program.ops[:upto]
+    defer_outs = set()
+    for node in nodes:
+        if deferred is not None:
+            waits = any(nm is not None and (nm not in env or nm in defer_outs)
+                        for nm in node.arg_names)
+            if waits:
+                deferred.append(node)
+                defer_outs.update(node.out_names)
+                continue
+        _run_node(node, env)
+    return env
+
+
+def _replay_nodes(nodes, env):
+    for node in nodes:
+        _run_node(node, env)
     return env
 
 
@@ -145,12 +170,26 @@ class Executor:
                 o._load_state_pytree(st)
             try:
                 env = dict(zip(feed_names, feed_vals))
-                env = _replay(program, env)
+                deferred = []
+                env = _replay(program, env, deferred=deferred)
                 if program._optimizers or program._grad_vars:
                     env.update(_grad_env(program, dict(zip(feed_names, feed_vals))))
+                if deferred:
+                    # ops recorded on @GRAD variables (grad-sync collectives
+                    # et al.) run once the grad env exists
+                    env = _replay_nodes(deferred, env)
                 for opt, loss_var in program._optimizers:
                     for p in params:
-                        g = env.get(f"{p.name}@GRAD")
+                        # resolve through the grad Variable's CURRENT name:
+                        # a recorded grad-sync collective rebinds it to the
+                        # collective's output, and the optimizer must consume
+                        # the synced value, not the raw per-rank grad
+                        gv = program._grad_vars.get(p.name)
+                        g = None
+                        if gv is not None:
+                            g = env.get(gv.name)
+                        if g is None:
+                            g = env.get(f"{p.name}@GRAD")
                         if g is not None and not p.stop_gradient:
                             p.grad = Tensor(g)
                     opt.step()

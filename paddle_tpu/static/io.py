@@ -29,7 +29,9 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 
     def fn(*feeds):
         env = {v.name: f for v, f in zip(feed_vars, feeds)}
-        env = _replay(program, env)
+        # deferred=[]: the inference slice drops grad-consuming ops
+        # (recorded grad-sync collectives live downstream of the loss)
+        env = _replay(program, env, deferred=[])
         outs = [env[v.name] for v in fetch_vars]
         return outs[0] if len(outs) == 1 else tuple(outs)
 

@@ -36,9 +36,15 @@ import hashlib
 
 try:
     _cpuinfo = open("/proc/cpuinfo").read()
-    _flags_line = next((l for l in _cpuinfo.splitlines()
-                        if l.startswith("flags")), "")
-    _cpu_key = hashlib.sha1(_flags_line.encode()).hexdigest()[:12]
+    _lines = _cpuinfo.splitlines()
+    _flags_line = next((l for l in _lines if l.startswith("flags")), "")
+    # include the model line too: pool machines with IDENTICAL cpuinfo
+    # flags can still differ in XLA-derived target features
+    # (prefer-no-scatter/-gather), and a key collision SIGABRTs mid-suite
+    # when an AOT executable from the other machine type loads
+    _model_line = next((l for l in _lines if l.startswith("model name")), "")
+    _cpu_key = hashlib.sha1(
+        (_flags_line + _model_line).encode()).hexdigest()[:12]
 except OSError:
     _cpu_key = "generic"
 jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_pt_cache_{_cpu_key}")

@@ -1,0 +1,76 @@
+"""Rank script for the two-node launch test: 2 nodes x 2 procs = world 4.
+
+Exercises the multi-node path (reference
+``launch/controllers/collective.py`` + ``gen_comm_id_helper.cc``
+bootstrap): two SEPARATE launcher invocations (--rank 0 / --rank 1) share
+one coordinator, the hybrid mesh gets an explicit dcn axis whose blocks
+are the nodes, and collectives run across the node boundary.
+"""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+rank, world = env.rank, env.world_size
+assert world == 4, world
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 4
+node = rank // 2  # 2 procs per node
+
+from paddle_tpu.distributed import fleet
+
+strategy = fleet.DistributedStrategy()
+strategy.hybrid_configs["dcn_degree"] = 2   # = nnodes: DP over DCN
+strategy.hybrid_configs["dp_degree"] = 2    # intra-node
+fleet.init(is_collective=True, strategy=strategy)
+hcg = fleet.get_hybrid_communicate_group()
+assert hcg.get_dcn_parallel_world_size() == 2
+mesh = hcg.mesh
+assert mesh.axis_names[0] == "dcn"  # outermost: only dcn traffic crosses DCN
+
+# device order: jax global devices are sorted by process, so the dcn axis
+# blocks correspond exactly to the two nodes
+devs = np.asarray(mesh.devices).reshape(2, -1)
+for b in range(2):
+    assert all(d.process_index in (2 * b, 2 * b + 1)
+               for d in devs[b].ravel()), devs
+
+# cross-node collective: each process contributes (rank+1); psum over the
+# FULL mesh must cross the node boundary
+local = np.full((1, 4), float(rank + 1), np.float32)
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(("dcn", "pp", "dp"))), local, (4, 4))
+total = jax.jit(lambda a: a.sum(),
+                out_shardings=NamedSharding(mesh, P()))(arr)
+got = float(np.asarray(jax.device_get(total)))
+assert got == 40.0, got  # (1+2+3+4) * 4 lanes
+
+# dcn-axis-only reduction: shard over dcn, psum along dcn => pairs of
+# node sums; verifies the dcn axis is a real comm group
+from jax import shard_map
+
+def body(x):
+    return jax.lax.psum(x, "dcn")
+
+f = jax.jit(shard_map(
+    body, mesh=mesh,
+    in_specs=(P(("dcn",)),), out_specs=P(("dcn",)), check_vma=False))
+arr2 = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P(("dcn",))), np.full((1, 2), float(node), np.float32),
+    (2, 2))
+out2 = np.asarray(jax.device_get(
+    jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))(f(arr2))))
+assert np.allclose(out2, 1.0), out2  # node0 + node1 = 0 + 1
+
+out_dir = os.environ["LAUNCH_TEST_OUT"]
+with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f2:
+    json.dump({"rank": rank, "node": node, "world": world, "psum": got}, f2)
+print(f"rank {rank} (node {node}) OK", flush=True)
+dist.barrier()

@@ -192,3 +192,32 @@ def test_python_heavy_transform_speedup():
     proc_time = time.perf_counter() - t0
 
     assert proc_time < threaded, (proc_time, threaded)
+
+
+def test_concurrent_iterators_on_persistent_loader():
+    """Review regression: a second live iterator must not cross epoch tags
+    with the persistent pool (it gets its own temporary pool)."""
+    ds = ArrayDataset()
+    loader = DataLoader(ds, batch_size=8, num_workers=2, use_process=True,
+                        persistent_workers=True)
+    it1, it2 = iter(loader), iter(loader)
+    a1 = [np.asarray(next(it1)[1]).tolist() for _ in range(4)]
+    a2 = [np.asarray(next(it2)[1]).tolist() for _ in range(4)]
+    assert a1 == a2
+    rest1 = [np.asarray(b[1]).tolist() for b in it1]
+    rest2 = [np.asarray(b[1]).tolist() for b in it2]
+    assert rest1 == rest2 and len(a1 + rest1) == 8
+
+
+def test_timeout_raises_on_hung_worker():
+    loader = DataLoader(HangDataset(), batch_size=4, num_workers=1,
+                        use_process=True, timeout=3)
+    with pytest.raises(WorkerFailure, match="timed out"):
+        list(loader)
+
+
+class HangDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 5:
+            time.sleep(600)
+        return super().__getitem__(i)

@@ -127,3 +127,16 @@ def test_router_prefers_packed(monkeypatch):
     with __import__("paddle_tpu").ops.pallas.interpret_mode():
         A._sdpa_flash(q, q, q, causal=True)
     assert called.get("hit")
+
+
+def test_single_tile_causal_fully_masked_rows():
+    """Review regression: sq > sk causal with one k tile — query rows with
+    no visible keys must output 0 (not the mean of v)."""
+    q = jnp.ones((1, 256, 2 * 64), jnp.float32)
+    k = jax.random.normal(jax.random.key(0), (1, 128, 2 * 64), jnp.float32)
+    v = jax.random.normal(jax.random.key(1), (1, 128, 2 * 64), jnp.float32)
+    out = flash_attention_packed(q, k, v, 2, causal=True, block_q=128,
+                                 block_k=128, interpret=True)
+    # offset = sk - sq = -128: rows 0..127 attend nothing -> zeros
+    np.testing.assert_array_equal(np.asarray(out[0, :128]), 0.0)
+    assert np.abs(np.asarray(out[0, 128:])).max() > 0

