@@ -5,9 +5,13 @@ TPU-native redesign: TPU slices are fixed-topology (a pod slice cannot gain
 chips mid-job), so "elastic" on TPU means FAULT RECOVERY, not live resize:
 the launcher (``distributed/launch``) restarts failed rank groups up to
 ``--max_restart`` with a fresh rendezvous, and this module provides the
-reference's manager surface over a shared-filesystem heartbeat registry
-(etcd's role; a pod's shared NFS/GCS mount in practice) so trainers can
-detect dead peers and trigger the restart path.
+reference's manager surface over a heartbeat registry playing etcd's role —
+the native TCPStore (``PADDLE_ELASTIC_STORE``, works across nodes; rank 0
+hosts) or a shared-filesystem fallback — so trainers can detect dead peers
+and trigger the restart path. Run heartbeat/watch from a dedicated agent
+thread that kills the trainer on RESTART: a rank blocked inside a
+collective whose peer died can never poll (see
+``tests/elastic_rank_script.py`` for the pattern).
 """
 from __future__ import annotations
 
@@ -27,12 +31,21 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    """File-registry membership manager. ``elastic_dir`` plays etcd's role:
-    each rank writes ``rank<i>.json`` heartbeats; ``watch`` reports RESTART
-    when a peer goes stale and EXIT/COMPLETED on clean shutdown."""
+    """Membership manager with two registries playing etcd's role:
+
+    * TCPStore (the native C++ store, ``core/native/tcp_store.cc``) when a
+      store address is available — rank 0 hosts, every rank heartbeats a
+      ``elastic/rank<i>`` key; this is the reference's etcd keepalive shape
+      (``distributed/elastic.py:23-45``) over the framework's own
+      bootstrap store, and works across nodes;
+    * a shared-filesystem fallback (``elastic_dir``) for single-node jobs
+      without a store.
+
+    ``watch`` reports RESTART when a peer goes stale/errored and
+    COMPLETED on clean global shutdown."""
 
     def __init__(self, args=None, elastic_dir=None, rank=None, world_size=None,
-                 timeout=30.0):
+                 timeout=30.0, store=None, store_addr=None):
         env = os.environ
         self.elastic_dir = (elastic_dir
                             or env.get("PADDLE_ELASTIC_DIR")
@@ -44,7 +57,19 @@ class ElasticManager:
                               else env.get("PADDLE_TRAINERS_NUM", 1))
         self.timeout = float(timeout)
         self.enable = self.world_size > 1 or elastic_dir is not None
-        os.makedirs(self.elastic_dir, exist_ok=True)
+        self._store = store
+        store_addr = store_addr or env.get("PADDLE_ELASTIC_STORE")
+        if self._store is None and store_addr:
+            from ..core.tcp_store import TCPStore
+
+            host, port = store_addr.rsplit(":", 1)
+            # rank 0 hosts; a restarted rank 0 rebinds the same port
+            self._store = TCPStore(host, int(port),
+                                   is_master=(self.rank == 0),
+                                   world_size=self.world_size,
+                                   timeout=max(self.timeout, 60.0))
+        if self._store is None:
+            os.makedirs(self.elastic_dir, exist_ok=True)
         self._hb_path = os.path.join(self.elastic_dir, f"rank{self.rank}.json")
 
     # -- registration / heartbeat (≙ etcd keepalive) -------------------------
@@ -52,10 +77,13 @@ class ElasticManager:
         self.heartbeat()
 
     def heartbeat(self, status="running"):
+        payload = {"rank": self.rank, "ts": time.time(), "status": status}
+        if self._store is not None:
+            self._store.set(f"elastic/rank{self.rank}", json.dumps(payload))
+            return
         tmp = self._hb_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"rank": self.rank, "ts": time.time(),
-                       "status": status}, f)
+            json.dump(payload, f)
         os.replace(tmp, self._hb_path)
 
     def exit(self, completed=True):
@@ -65,6 +93,20 @@ class ElasticManager:
     # -- membership view ------------------------------------------------------
     def _peers(self):
         out = {}
+        if self._store is not None:
+            from ..core.tcp_store import TCPStoreError
+
+            for r in range(self.world_size):
+                try:
+                    # near-nonblocking probe: a blocking per-key wait would
+                    # make one poll cost O(world) x timeout during bringup,
+                    # stalling the poller's own heartbeats
+                    raw = self._store.get(f"elastic/rank{r}", timeout=0.05)
+                    d = json.loads(raw)
+                    out[int(d["rank"])] = d
+                except (TCPStoreError, ValueError, KeyError):
+                    pass  # not registered yet
+            return out
         for name in os.listdir(self.elastic_dir):
             if name.startswith("rank") and name.endswith(".json"):
                 try:

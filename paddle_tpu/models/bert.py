@@ -73,8 +73,12 @@ class BertEmbeddings(Layer):
     def forward(self, input_ids, token_type_ids=None, position_ids=None):
         b, s = input_ids.shape
         if position_ids is None:
-            position_ids = Tensor(
-                np.arange(s, dtype=np.int64)[None, :].repeat(b, 0))
+            # (1, s): the embedding broadcasts over batch — materializing
+            # the batch dim would force a constant where dynamic-batch
+            # export (symbolic b) must stay polymorphic
+            import jax.numpy as jnp
+
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
         if token_type_ids is None:
             token_type_ids = ops.zeros_like(input_ids)
         h = (self.word_embeddings(input_ids)

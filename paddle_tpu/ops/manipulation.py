@@ -12,6 +12,32 @@ from ..framework.tensor import Tensor
 from .dispatch import op, ensure_tensor
 
 
+def _as_dim(s):
+    """A SHAPE entry: concrete int, or a symbolic export dimension (jax
+    shape polymorphism — dynamic-batch jit.save), which must pass through
+    unforced. Only shape-taking ops (reshape/expand/tile) accept symbolic
+    entries; axis/shift/slice arguments stay strictly int (_ints) so bad
+    values still fail loudly at the API boundary."""
+    if isinstance(s, Tensor):
+        s = s._value
+    if isinstance(s, (int, np.integer)):
+        return int(s)
+    from jax.export import is_symbolic_dim
+
+    if is_symbolic_dim(s):
+        return s
+    return int(s)
+
+
+def _dims(shape):
+    """Shape parser: ints + symbolic export dims."""
+    if isinstance(shape, Tensor):
+        return [int(v) for v in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [_as_dim(s) for s in shape]
+
+
 def _ints(shape):
     if isinstance(shape, Tensor):
         return [int(v) for v in np.asarray(shape._value)]
@@ -39,7 +65,7 @@ def _reshape_raw(x, shape=None):
 
 
 def reshape(x, shape, name=None):
-    return _reshape_raw(x, shape=tuple(_ints(shape)))
+    return _reshape_raw(x, shape=tuple(_dims(shape)))
 
 
 def reshape_(x, shape, name=None):
@@ -196,7 +222,7 @@ def _tile_raw(x, repeat_times=()):
 
 
 def tile(x, repeat_times, name=None):
-    return _tile_raw(x, repeat_times=tuple(_ints(repeat_times)))
+    return _tile_raw(x, repeat_times=tuple(_dims(repeat_times)))
 
 
 @op("expand")
@@ -211,7 +237,7 @@ def _expand_raw(x, shape=()):
 
 
 def expand(x, shape, name=None):
-    return _expand_raw(x, shape=tuple(_ints(shape)))
+    return _expand_raw(x, shape=tuple(_dims(shape)))
 
 
 def expand_as(x, y, name=None):

@@ -1,0 +1,114 @@
+"""Rank script for the elastic kill-rejoin test (round-3 VERDICT missing #4).
+
+2-rank DP training with: TCPStore-backed heartbeats (ElasticManager), a
+background watch thread (the elastic-agent role: a rank hung inside a
+collective whose peer died cannot poll — the agent must kill it),
+auto_checkpoint epoch resume, and a mid-epoch SIGKILL of rank 1 on the
+first attempt. The launcher's --max_restart respawns the job; training
+resumes from the last checkpoint; the final state must equal an
+uninterrupted run's.
+"""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+
+out_dir = os.environ["LAUNCH_TEST_OUT"]
+kill_marker = os.path.join(out_dir, "killed.marker")
+do_kill = os.environ.get("ELASTIC_TEST_KILL") == "1"
+
+env = dist.init_parallel_env()
+rank, world = env.rank, env.world_size
+assert world == 2, world
+ckpt_dir = os.path.join(out_dir, f"acp_rank{rank}")
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+em = ElasticManager(timeout=4.0)
+assert em._store is not None, "test requires the TCPStore heartbeat backend"
+em.register()
+_done = threading.Event()
+
+
+def _agent():
+    """Heartbeat + dead-peer watch. os._exit on RESTART: the trainer may be
+    blocked inside a collective with the dead peer and can never return."""
+    while not _done.is_set():
+        try:
+            em.heartbeat()
+            if em.watch() == ElasticStatus.RESTART:
+                print(f"rank {rank}: peer failure detected via store watch",
+                      flush=True)
+                os._exit(23)
+        except Exception:
+            pass
+        time.sleep(0.5)
+
+
+threading.Thread(target=_agent, daemon=True).start()
+
+paddle.seed(0)
+lin = paddle.nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=lin.parameters())
+acp.reset()
+acp.register(model=lin, optimizer=opt)
+
+from paddle_tpu.jit.functionalize import CompiledStep
+
+
+def step(x):
+    loss = lin(x).square().mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+cs = CompiledStep(step, stateful=[lin, opt], donate_state=False)
+
+epochs_run = []
+losses = []
+for epoch in acp.train_epoch_range(4, save_dir=ckpt_dir):
+    epochs_run.append(epoch)
+    for it in range(3):
+        # deterministic per-(epoch, iter, rank) data
+        rng = np.random.RandomState(1000 * epoch + 10 * it + rank)
+        x_local = rng.randn(2, 8).astype(np.float32)
+        x = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), x_local, (4, 8))
+
+        if (do_kill and rank == 1 and epoch == 1 and it == 1
+                and not os.path.exists(kill_marker)):
+            with open(kill_marker, "w") as f:
+                f.write("killed")
+            os.kill(os.getpid(), signal.SIGKILL)  # simulated node failure
+
+        loss = cs(Tensor(x))
+        losses.append(float(np.asarray(jax.device_get(loss._value))))
+
+_done.set()
+try:
+    em.exit(completed=True)
+except Exception:
+    # rank 0 hosts the store in-process; if it already exited, the final
+    # status write has nowhere to land — not a training failure
+    pass
+attempt = "restarted" if os.path.exists(kill_marker) else "clean"
+w = np.asarray(jax.device_get(lin.weight._value)).ravel().tolist()
+b = np.asarray(jax.device_get(lin.bias._value)).ravel().tolist()
+with open(os.path.join(out_dir, f"final_rank{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "attempt": attempt, "epochs": epochs_run,
+               "w": w, "b": b, "last_loss": losses[-1]}, f)
+print(f"rank {rank} DONE epochs={epochs_run}", flush=True)

