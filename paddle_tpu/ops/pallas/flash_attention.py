@@ -85,16 +85,20 @@ def _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=None):
     hh = pl.program_id(1) if head is None else head
     pltpu.prng_seed(seed_ref[0] ^ (qi * 65536 + ki),
                     seed_ref[1] ^ (bb * 1024 + hh))
-    # 16 random bits per element suffice for the keep test (rate resolution
-    # 1/65536) and halve the PRNG work vs 32: draw half the sublanes as
-    # uint32, bitcast to uint16 (which doubles the sublane dim back).
+    return _keep_bits(shape, dropout_p)
+
+
+def _keep_bits(shape, dropout_p):
+    """Draw the keep mask for an already-seeded PRNG. 16 random bits per
+    element suffice for the keep test (rate resolution 1/65536) and halve
+    the PRNG work vs 32: draw half the sublanes as uint32, bitcast to
+    uint16 (which doubles the sublane dim back). Compare in int32: the VPU
+    has no 16-bit compare ("Target does not support this comparison"); the
+    widening is cheap relative to PRNG."""
     bits = pltpu.bitcast(
         pltpu.prng_random_bits((shape[0] // 2, shape[1])), jnp.uint16
     )
-    keep = 1.0 - dropout_p
-    thr = min(int(keep * 65536.0), 65535)
-    # compare in int32: the VPU has no 16-bit compare ("Target does not
-    # support this comparison"); the widening is cheap relative to PRNG
+    thr = min(int((1.0 - dropout_p) * 65536.0), 65535)
     return bits.astype(jnp.int32) < thr
 
 

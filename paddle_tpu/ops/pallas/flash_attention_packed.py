@@ -52,6 +52,7 @@ from .flash_attention import (
     _causal_run,
     _dropout_mask,
     _inject_none,
+    _keep_bits,
     _pick_block,
 )
 
@@ -93,10 +94,33 @@ def _head_logits(q_ref, k_ref, add, j, d, scale):
     return s
 
 
+DROP_UNIT = 512  # canonical dropout tile: mask depends only on ABSOLUTE
+                 # (row-unit, col-unit), so fwd and bwd may tile differently
+
+
 def _drop(seed_ref, j, hpg, qi, ki, shape, dropout_p):
-    # global head index = group * heads_per_group + static offset
+    """Keep-mask for this tile, assembled from canonical 512x512 units so
+    the forward (1024-tiles, single-k fast path) and backward (512-tiles,
+    VMEM headroom) regenerate identical bits; non-512-multiple blocks fall
+    back to the tile-shape-keyed draw (the caller then unifies fwd/bwd
+    block sizes)."""
     head = pl.program_id(1) * hpg + j
-    return _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=head)
+    bq, bk = shape
+    if bq % DROP_UNIT or bk % DROP_UNIT:
+        return _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=head)
+    bb = pl.program_id(0)
+    ru, cu = bq // DROP_UNIT, bk // DROP_UNIT
+    rows = []
+    for ur in range(ru):
+        cols = []
+        for uc in range(cu):
+            aur = qi * ru + ur
+            auc = ki * cu + uc
+            pltpu.prng_seed(seed_ref[0] ^ (aur * 65536 + auc),
+                            seed_ref[1] ^ (bb * 1024 + head))
+            cols.append(_keep_bits((DROP_UNIT, DROP_UNIT), dropout_p))
+        rows.append(cols[0] if cu == 1 else jnp.concatenate(cols, axis=1))
+    return rows[0] if ru == 1 else jnp.concatenate(rows, axis=0)
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
@@ -181,58 +205,27 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
                 )
 
 
-def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
-                   lse_ref, dq_ref, dq_acc, *, hpg, d, scale, causal,
-                   block_q, block_k, offset, dropout_p):
-    qi, ki = pl.program_id(2), pl.program_id(3)
-    nk = pl.num_programs(3)
-
-    @pl.when(ki == 0)
-    def _init():
-        dq_acc[:] = jnp.zeros_like(dq_acc)
-
-    run = _causal_run(qi, ki, block_q, block_k, offset) if causal else (ki >= 0)
-
-    @pl.when(run)
-    def _body():
-        add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
-        for j in range(hpg):
-            s = _head_logits(q_ref, k_ref, add, j, d, scale)
-            p = jnp.exp(s - lse_ref[0, j][:, 0:1])
-            doh = do_ref[0, :, j * d:(j + 1) * d]
-            oh = o_ref[0, :, j * d:(j + 1) * d]
-            delta = jnp.sum(
-                doh.astype(jnp.float32) * oh.astype(jnp.float32),
-                axis=-1, keepdims=True,
-            )
-            vh = v_ref[0, :, j * d:(j + 1) * d]
-            dp = jax.lax.dot_general(
-                doh, vh,
-                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-            )
-            if dropout_p > 0.0:
-                keep = _drop(seed_ref, j, hpg, qi, ki, s.shape, dropout_p)
-                dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_p)), 0.0)
-            ds = p * (dp - delta) * scale
-            kh = k_ref[0, :, j * d:(j + 1) * d]
-            dq_acc[0, :, j * d:(j + 1) * d] = (
-                dq_acc[0, :, j * d:(j + 1) * d] + jax.lax.dot_general(
-                    ds.astype(kh.dtype), kh,
-                    (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-            )
-
-    @pl.when(ki == nk - 1)
-    def _finish():
-        dq_ref[0] = dq_acc[0].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
-                    lse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, hpg, d,
-                    scale, causal, block_q, block_k, offset, dropout_p):
+def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
+                      lse_ref, dq_ref, dk_ref, dv_ref,
+                      dq_sc, dk_acc, dv_acc, *, hpg, d, scale, causal,
+                      block_q, block_k, offset, dropout_p):
+    """Single-sweep backward: grid (b, group, K block, Q block). dk/dv
+    accumulate in per-k-block scratch over the inner q sweep (written once
+    per k block); dq accumulates in a scratch slab holding EVERY q block
+    (``(nq, block_q, width)`` f32 — VMEM persists across the whole grid),
+    written through to the revisited dq output each step so the LAST
+    write (ki == nk-1) carries the full sum in both compiled and
+    interpret modes. The payoff over split dq / dkv kernels: s,
+    p=exp(s-lse), dp and ds are computed ONCE instead of twice — measured
+    on v5e they dominate the backward. The slab caps supported seq_q
+    (~16k at 512 blocks); longer sequences route to the layout-swapping
+    kernel."""
     ki, qi = pl.program_id(2), pl.program_id(3)
     nq = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _dq_init():
+        dq_sc[qi] = jnp.zeros_like(dq_sc[qi])
 
     @pl.when(qi == 0)
     def _init():
@@ -281,6 +274,18 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
                     preferred_element_type=jnp.float32,
                 )
             )
+            kh = k_ref[0, :, j * d:(j + 1) * d]
+            dq_sc[qi, :, j * d:(j + 1) * d] = (
+                dq_sc[qi, :, j * d:(j + 1) * d] + jax.lax.dot_general(
+                    ds.astype(kh.dtype), kh,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+
+    # write-through every step: intermediate write-backs are overwritten by
+    # the revisit at the next ki; the ki == nk-1 write is the full sum
+    dq_ref[0] = dq_sc[qi].astype(dq_ref.dtype)
 
     @pl.when(qi == nq - 1)
     def _finish():
@@ -404,81 +409,55 @@ def _bwd(h, scale, causal, block_q, block_k, interpret, dropout_p, bwd_block,
     nq, nk = sq // block_q, sk // block_k
     offset = sk - sq
 
-    def qmap(bb, hg, qi, ki):
+    def qmap(bb, hg, ki, qi):
         return (bb, qi, hg)
 
-    def kmap(bb, hg, qi, ki):
+    def kmap(bb, hg, ki, qi):
         return (bb, ki, hg)
 
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
+    kernel = functools.partial(
+        _bwd_fused_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
     )
+    # full signature: (seed, q, k, v, bias, do, o, lse, dq, dk, dv,
+    #                  <dq slab, dk acc, dv acc scratch>)
     missing = ([0] if seed is None else []) + ([4] if bias is None else [])
     if missing:
-        dq_kernel = _inject_none(dq_kernel, *missing)
-    dq_specs = [
+        kernel = _inject_none(kernel, *missing)
+    in_specs = [
         _seed_spec(seed),
         pl.BlockSpec((1, block_q, width), qmap),       # q
         pl.BlockSpec((1, block_k, width), kmap),       # k
         pl.BlockSpec((1, block_k, width), kmap),       # v
-        _bias_spec(bias, block_q, block_k),            # bias
+        _bias_spec(bias, block_q, block_k, kv_major=True),
         pl.BlockSpec((1, block_q, width), qmap),       # do
         pl.BlockSpec((1, block_q, width), qmap),       # o
         pl.BlockSpec((1, hpg, block_q, STAT_LANES),
-                     lambda bb, hg, qi, ki: (bb, hg, qi, 0)),  # lse
-    ]
-    dq = pl.pallas_call(
-        dq_kernel,
-        grid=(b, ng, nq, nk),
-        in_specs=[s for s in dq_specs if s is not None],
-        out_specs=pl.BlockSpec((1, block_q, width), qmap),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((1, block_q, width), jnp.float32)],
-        interpret=interpret,
-    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
-
-    def kv_qmap(bb, hg, ki, qi):
-        return (bb, qi, hg)
-
-    def kv_kmap(bb, hg, ki, qi):
-        return (bb, ki, hg)
-
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, hpg=hpg, d=d, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=offset, dropout_p=dropout_p,
-    )
-    if missing:
-        dkv_kernel = _inject_none(dkv_kernel, *missing)
-    dkv_specs = [
-        _seed_spec(seed),
-        pl.BlockSpec((1, block_q, width), kv_qmap),    # q
-        pl.BlockSpec((1, block_k, width), kv_kmap),    # k
-        pl.BlockSpec((1, block_k, width), kv_kmap),    # v
-        _bias_spec(bias, block_q, block_k, kv_major=True),
-        pl.BlockSpec((1, block_q, width), kv_qmap),    # do
-        pl.BlockSpec((1, block_q, width), kv_qmap),    # o
-        pl.BlockSpec((1, hpg, block_q, STAT_LANES),
                      lambda bb, hg, ki, qi: (bb, hg, qi, 0)),  # lse
     ]
-    dk, dv = pl.pallas_call(
-        dkv_kernel,
+    operands = [x for x in (seed, q, k, v, bias) if x is not None]
+    operands += [g, out, lse]
+    dq, dk, dv = pl.pallas_call(
+        kernel,
         grid=(b, ng, nk, nq),
-        in_specs=[s for s in dkv_specs if s is not None],
+        in_specs=[sp for sp in in_specs if sp is not None],
         out_specs=[
-            pl.BlockSpec((1, block_k, width), kv_kmap),
-            pl.BlockSpec((1, block_k, width), kv_kmap),
+            pl.BlockSpec((1, block_q, width), qmap),
+            pl.BlockSpec((1, block_k, width), kmap),
+            pl.BlockSpec((1, block_k, width), kmap),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         scratch_shapes=[
+            pltpu.VMEM((nq, block_q, width), jnp.float32),
             pltpu.VMEM((1, block_k, width), jnp.float32),
             pltpu.VMEM((1, block_k, width), jnp.float32),
         ],
         interpret=interpret,
-    )(*[x for x in (seed, q, k, v, bias, g, out, lse) if x is not None])
+    )(*operands)
 
     if bias is None:
         dbias = None
@@ -492,16 +471,23 @@ def _bwd(h, scale, causal, block_q, block_k, interpret, dropout_p, bwd_block,
 _flash.defvjp(_fwd, _bwd)
 
 
+MAX_BWD_SLAB_BYTES = 10 * 2 ** 20  # dq scratch slab cap (VMEM budget)
+
+
 def supports(seq_q, seq_k, num_heads, embed_dim,
              block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Shape gate: lane-tileable seqs; head_dim must pack into 128-lane
     groups (d a divisor or multiple of 128) with the head count divisible
-    by the group size."""
+    by the group size; seq_q bounded by the backward's resident dq slab
+    (~16k at 128-lane groups) — longer routes to the layout-swapping
+    kernel (or ring attention)."""
     if embed_dim % num_heads:
         return False
     d = embed_dim // num_heads
-    hpg, _ = _group_width(d)
+    hpg, width = _group_width(d)
     if not hpg or num_heads % hpg:
+        return False
+    if seq_q * width * 4 > MAX_BWD_SLAB_BYTES:
         return False
     return _pick_block(seq_q, block_q) > 0 and _pick_block(seq_k, block_k) > 0
 
@@ -550,12 +536,30 @@ def flash_attention_packed(q, k, v, num_heads, bias=None, *, causal=False,
     block_q = _pick_block(sq, block_q)
     block_k = _pick_block(sk, block_k)
     bwd_block = _pick_block(sq, bwd_block) or block_q
-    if dropout_p > 0.0:
-        # the PRNG keep-mask is a function of (tile index, tile SHAPE):
-        # backward MUST re-tile exactly like the forward or dq/dkv would
-        # regenerate a mask uncorrelated with the one the forward applied
-        block_q = block_k = bwd_block = min(
-            x for x in (block_q, block_k, bwd_block) if x)
+    if dropout_p > 0.0 and (block_q % DROP_UNIT or block_k % DROP_UNIT
+                            or bwd_block % DROP_UNIT):
+        # non-canonical tile sizes key the PRNG mask on tile SHAPE: the
+        # backward must then re-tile exactly like the forward (canonical
+        # 512-unit draws lift this, letting fwd keep 1024 single-k tiles
+        # while bwd runs its 512 VMEM-friendly ones). The unified block
+        # must divide BOTH seq dims — pick from gcd(sq, sk), never the raw
+        # min (which could silently truncate the key range when sq != sk)
+        u = min(x for x in (block_q, block_k, bwd_block) if x)
+        u = _pick_block(math.gcd(sq, sk), u)
+        if not u:
+            raise ValueError(
+                f"dropout tiling: no common 128-aligned block divides both "
+                f"seq_q={sq} and seq_k={sk}"
+            )
+        block_q = block_k = bwd_block = u
+    hpg_chk, width_chk = _group_width(e // h if h else 1)
+    if hpg_chk and sq * width_chk * 4 > MAX_BWD_SLAB_BYTES:
+        raise ValueError(
+            f"flash_attention_packed: seq_q={sq} exceeds the backward dq "
+            f"slab budget (~{MAX_BWD_SLAB_BYTES // (width_chk * 4)} rows at "
+            f"this head width) — use the layout-swapping flash_attention "
+            f"or ring attention for longer sequences"
+        )
     if not supports(sq, sk, h, e, block_q or 1, block_k or 1) \
             or not (block_q and block_k):
         raise ValueError(

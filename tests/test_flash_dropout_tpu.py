@@ -142,6 +142,43 @@ def test_packed_grad_matches_finite_difference(wrt):
         assert abs(fd - g[idx]) < 2e-2 + 0.05 * abs(fd), (idx, fd, g[idx])
 
 
+@pytest.mark.parametrize("wrt", [0, 2])
+def test_packed_canonical_units_grad_fd(wrt):
+    """Flagship tiling with dropout: fwd at 1024 single-k tiles, bwd at
+    512 — the canonical 512x512 dropout units must give both the SAME
+    mask; a finite-difference check fails if they diverge."""
+    from paddle_tpu.ops.pallas.flash_attention_packed import (
+        flash_attention_packed,
+    )
+
+    b, s, h, d = 1, 1024, 2, 64
+    ks = jax.random.split(jax.random.key(21), 3)
+    args = [jax.random.normal(k_, (b, s, h * d), jnp.float32) * 0.3
+            for k_ in ks]
+    seed = jnp.array([7, 9], jnp.int32)
+    co = jax.random.normal(jax.random.key(2), args[0].shape, jnp.float32)
+
+    def f(*a):
+        out = flash_attention_packed(
+            a[0], a[1], a[2], h, causal=True, dropout_p=0.25,
+            dropout_seed=seed, block_q=1024, block_k=1024, bwd_block=512,
+            interpret=False)
+        return jnp.vdot(out, co)
+
+    g = np.asarray(jax.grad(f, argnums=wrt)(*args))
+    rng = np.random.RandomState(3)
+    x = np.asarray(args[wrt])
+    eps = 1e-2
+    for _ in range(4):
+        idx = tuple(rng.randint(0, dim) for dim in x.shape)
+        e = np.zeros_like(x)
+        e[idx] = eps
+        hi = [a if i != wrt else jnp.asarray(x + e) for i, a in enumerate(args)]
+        lo = [a if i != wrt else jnp.asarray(x - e) for i, a in enumerate(args)]
+        fd = (float(f(*hi)) - float(f(*lo))) / (2 * eps)
+        assert abs(fd - g[idx]) < 2e-2 + 0.05 * abs(fd), (idx, fd, g[idx])
+
+
 def test_sdpa_router_keeps_flash_with_dropout():
     """F.scaled_dot_product_attention with dropout>0 must stay on the flash
     path on a compiled TPU backend (round-3 VERDICT weak #2)."""
