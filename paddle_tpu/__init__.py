@@ -31,7 +31,15 @@ from .framework.dtype import (  # noqa: F401
     get_default_dtype,
     set_default_dtype,
 )
+import jax.numpy as _jnp_for_dtype
+
+# paddle.dtype / paddle.bool (reference: core.VarDesc.VarType aliases; here
+# dtypes ARE numpy/jnp dtypes, so the constructor-alias is jnp.dtype)
+dtype = _jnp_for_dtype.dtype
+from .framework.dtype import bool_ as bool  # noqa: F401,A001
+
 from .framework.place import (  # noqa: F401
+    NPUPlace,
     CPUPlace,
     CUDAPinnedPlace,
     CUDAPlace,
@@ -42,11 +50,15 @@ from .framework.place import (  # noqa: F401
     is_compiled_with_cuda,
     is_compiled_with_tpu,
 )
-from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, get_cuda_rng_state,
+    set_cuda_rng_state,
+)
 from .framework.flags import set_flags, get_flags  # noqa: F401
 from .framework.tensor import Parameter, Tensor, to_tensor, is_tensor  # noqa: F401
 
 # the whole tensor-op surface (also patches Tensor methods)
+from .distributed.data_parallel import DataParallel  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import add_n, einsum  # noqa: F401
 from .ops.random import (  # noqa: F401
@@ -161,3 +173,25 @@ def __getattr__(name):
 
         return importlib.import_module(".distributed", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def check_shape(shape):
+    """Reference ``fluid/data_feeder.py check_shape``: validate a shape spec
+    (ints or a 1-D integer Tensor; -1 allowed as the dynamic marker)."""
+    from .framework.tensor import Tensor as _T
+
+    if isinstance(shape, _T):
+        if shape.ndim != 1:
+            raise TypeError("shape tensor must be 1-D")
+        return
+    for s_ in shape:
+        if not isinstance(s_, (int,)) or (s_ < 0 and s_ != -1):
+            raise TypeError(
+                f"shape entries must be non-negative ints or -1, got {s_!r}")
+
+
+def disable_signal_handler():
+    """Reference ``fluid/framework.py:736``: Paddle installs fault-signal
+    handlers at import; jax/XLA installs none, so there is nothing to
+    disable — kept for call-site compatibility."""
+    return None

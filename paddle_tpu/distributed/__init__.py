@@ -51,7 +51,32 @@ from . import auto_parallel  # noqa: F401
 from . import elastic  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 
+from .compat_ps import (  # noqa: F401
+    CountFilterEntry,
+    InMemoryDataset,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    split,
+)
+from . import launch  # noqa: F401
+
 __all__ = [
+    "ParallelMode",
+    "split",
+    "launch",
+    "gloo_init_parallel_env",
+    "gloo_barrier",
+    "gloo_release",
+    "InMemoryDataset",
+    "QueueDataset",
+    "CountFilterEntry",
+    "ProbabilityEntry",
+    "ShowClickEntry",
     "ReduceOp",
     "Group",
     "new_group",

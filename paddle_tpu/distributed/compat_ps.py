@@ -1,0 +1,107 @@
+"""Reference-surface shims: ParallelMode, split, gloo_*, PS-era datasets.
+
+``split`` (reference ``distributed/collective.py:split``) is real: it
+builds the matching megatron-style parallel layer over the model-parallel
+group and applies it. The gloo_* trio are no-op bootstrap shims (gloo's
+rendezvous role is played by the native TCPStore + jax.distributed). The
+parameter-server dataset/entry classes raise: PS mode is descoped per
+SURVEY §7 (the reference uses them only for the PS data pipeline).
+"""
+from __future__ import annotations
+
+__all__ = [
+    "ParallelMode", "split", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+class ParallelMode:
+    """reference ``distributed/parallel.py ParallelMode``."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference ``distributed/collective.py split``: build and apply the
+    model-parallel layer for ``operation`` ('linear' | 'embedding') with
+    the weight split over the mp group."""
+    from .meta_parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False, gather_output=gather_out)
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = size
+        layer = VocabParallelEmbedding(num_emb, emb_dim,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Bootstrap shim: the TCPStore + jax.distributed rendezvous replaces
+    gloo (see ``distributed/parallel.py init_parallel_env``)."""
+    from .parallel import init_parallel_env
+
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    return barrier()
+
+
+def gloo_release():
+    return None
+
+
+def _ps_descoped(name):
+    raise RuntimeError(
+        f"{name} belongs to the parameter-server training mode, which is "
+        "descoped on the TPU build (SURVEY §7): PS pull/push does not map "
+        "to the SPMD execution model. Use DataLoader + collective data "
+        "parallelism instead."
+    )
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        _ps_descoped("InMemoryDataset")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        _ps_descoped("QueueDataset")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("CountFilterEntry")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("ProbabilityEntry")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        _ps_descoped("ShowClickEntry")

@@ -57,6 +57,13 @@ class CUDAPinnedPlace(CPUPlace):
     pass
 
 
+class NPUPlace(TPUPlace):
+    """Reference compat (Ascend NPU): maps to the accelerator place."""
+
+    def __init__(self, device_id=0):
+        super().__init__(device_id)
+
+
 class XPUPlace(TPUPlace):
     pass
 

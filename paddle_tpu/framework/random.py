@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["seed", "Generator", "default_generator", "next_key",
-           "get_rng_state", "set_rng_state", "derive_scope"]
+           "get_rng_state", "set_rng_state", "get_cuda_rng_state",
+           "set_cuda_rng_state", "derive_scope"]
 
 
 class Generator:
@@ -118,3 +119,14 @@ def get_rng_state():
 
 def set_rng_state(state):
     default_generator.set_state(state)
+
+
+def get_cuda_rng_state():
+    """Reference compat: device RNG state. One generator drives all devices
+    here (the key is a jax array placed by XLA), so this is the global
+    state."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)

@@ -10,6 +10,7 @@ from .layer.norm import *  # noqa: F401,F403
 from .layer.activation import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
 from . import quant  # noqa: F401

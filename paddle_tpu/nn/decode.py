@@ -1,0 +1,171 @@
+"""Seq2seq decoding: ``BeamSearchDecoder`` + ``dynamic_decode``.
+
+Reference: ``python/paddle/nn/decode.py`` (BeamSearchDecoder over an
+RNNCell-like step function; dynamic_decode drives Decoder.initialize/step
+until all beams finish, then walks parent pointers with gather_tree).
+
+TPU-native notes: the decode loop is a host loop over jitted steps — the
+data-dependent stop condition lives on the host exactly like the
+reference's dygraph path (a ``lax.while_loop`` version would forbid the
+user-supplied Python cell). States are arbitrary pytrees of Tensors;
+beam gathers tree-map over them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from . import functional as F
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+class Decoder:
+    """Abstract decode contract (reference ``nn/decode.py Decoder``)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a cell (reference ``nn/decode.py:102``).
+
+    ``cell(inputs, states) -> (logits_or_cell_out, next_states)``;
+    ``embedding_fn`` maps token ids to cell inputs; ``output_fn`` maps the
+    cell output to vocab logits when the cell itself does not.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] (reference helper)."""
+        v = _val(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]))
+
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        return v.reshape((self._batch, self.beam_size) + v.shape[1:])
+
+    def initialize(self, inits):
+        states = jax.tree_util.tree_map(_val, inits)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0]
+        self._batch = batch
+        # beam-tile every state leaf
+        states = jax.tree_util.tree_map(
+            lambda v: jnp.repeat(v[:, None], self.beam_size, axis=1).reshape(
+                (-1,) + v.shape[1:]), states)
+        ids = jnp.full((batch, self.beam_size), self.start_token, jnp.int64)
+        # only beam 0 live initially (identical beams would tie forever)
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))
+        finished = jnp.zeros((batch, self.beam_size), jnp.bool_)
+        init = {"states": states, "log_probs": log_probs,
+                "finished": finished, "lengths": jnp.zeros(
+                    (batch, self.beam_size), jnp.int64)}
+        return ids, init, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_states = states["states"]
+        emb = (self.embedding_fn(Tensor(self._merge(_val(inputs))))
+               if self.embedding_fn is not None
+               else Tensor(self._merge(_val(inputs))))
+        out, next_cell_states = self.cell(emb, jax.tree_util.tree_map(
+            Tensor, cell_states), **kwargs)
+        logits = self.output_fn(out) if self.output_fn is not None else out
+        logits = _val(logits)
+        vocab = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = self._split(logp)                     # [batch, beam, vocab]
+
+        finished = states["finished"]
+        # finished beams may only emit end_token at zero cost
+        fin_mask = jnp.full((vocab,), -1e9, jnp.float32).at[
+            self.end_token].set(0.0)
+        logp = jnp.where(finished[..., None], fin_mask[None, None], logp)
+        total = states["log_probs"][..., None] + logp
+
+        flat = total.reshape(self._batch, -1)
+        top_scores, top_idx = jax.lax.top_k(flat, self.beam_size)
+        parents = (top_idx // vocab).astype(jnp.int64)
+        tokens = (top_idx % vocab).astype(jnp.int64)
+
+        def gather_beam(v):
+            vs = self._split(v)
+            idx = parents.reshape(
+                (self._batch, self.beam_size) + (1,) * (vs.ndim - 2))
+            return jnp.take_along_axis(
+                vs, idx, axis=1).reshape((-1,) + vs.shape[2:])
+
+        next_cell_states = jax.tree_util.tree_map(
+            lambda t: gather_beam(_val(t)), next_cell_states)
+        new_finished = (jnp.take_along_axis(finished, parents, 1)
+                        | (tokens == self.end_token))
+        lengths = jnp.take_along_axis(states["lengths"], parents, 1)
+        lengths = jnp.where(new_finished, lengths, lengths + 1)
+
+        next_states = {"states": next_cell_states, "log_probs": top_scores,
+                       "finished": new_finished, "lengths": lengths}
+        outputs = {"scores": top_scores, "predicted_ids": tokens,
+                   "parent_ids": parents}
+        return outputs, next_states, tokens, new_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        ids = jnp.stack([o["predicted_ids"] for o in outputs], 0)
+        parents = jnp.stack([o["parent_ids"] for o in outputs], 0)
+        walked = F.gather_tree(Tensor(ids), Tensor(parents))
+        return walked, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive a Decoder until every sequence finishes or ``max_step_num``
+    (reference ``nn/decode.py dynamic_decode``)."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    while True:
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        outputs.append(out)
+        step += 1
+        if bool(np.asarray(finished).all()):
+            break
+        if max_step_num is not None and step >= max_step_num:
+            break
+    final, final_states = decoder.finalize(outputs, states, None)
+    if not output_time_major and isinstance(final, Tensor):
+        final = Tensor(jnp.moveaxis(final._value, 0, 1))
+    final_states = jax.tree_util.tree_map(
+        lambda v: Tensor(v) if not isinstance(v, Tensor) else v,
+        final_states)
+    if return_length:
+        return final, final_states, Tensor(final_states["lengths"]._value
+                                           if isinstance(final_states, dict)
+                                           else states["lengths"])
+    return final, final_states

@@ -387,3 +387,125 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is None:
         return _bilinear_raw(x1, x2, weight)
     return _bilinear_raw(x1, x2, weight, bias)
+
+
+# -- round-4 API-audit additions --------------------------------------------
+
+@op("diag_embed")
+def _diag_embed_raw(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    size = n + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (size, size), x.dtype)
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+    # place the new square dims at (dim1, dim2)
+    order = []
+    src = {d1: nd - 2, d2: nd - 1}
+    it = iter(perm)
+    for i in range(nd):
+        order.append(src[i] if i in src else next(it))
+    return jnp.transpose(out, order)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched vectors -> matrices with the vector on the (offset) diagonal
+    (reference ``nn/functional/extension.py:34``)."""
+    return _diag_embed_raw(input, offset=int(offset), dim1=int(dim1),
+                           dim2=int(dim2))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad a 4-D tensor's spatial dims with (left, right, top, bottom)
+    (reference ``nn/functional/common.py:1541``)."""
+    if isinstance(padding, Tensor):
+        padding = [int(v) for v in padding.numpy()]
+    l, r, t, b = (int(p) for p in padding)
+    if data_format == "NCHW":
+        widths = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        widths = [(0, 0), (t, b), (l, r), (0, 0)]
+    from ...ops.dispatch import apply_op
+
+    return apply_op("zeropad2d", lambda v: jnp.pad(v, widths), (x,), {})
+
+
+@op("temporal_shift")
+def _temporal_shift_raw(x, seg_num=1, shift_ratio=0.25, channel_last=False):
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    # segment t takes: first fold channels from t+1 (shift back), next fold
+    # from t-1 (shift forward), the rest unshifted (TSM, reference
+    # phi/kernels temporal_shift)
+    back = jnp.concatenate(
+        [xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, fold:2 * fold]), xr[:, :-1, fold:2 * fold]],
+        axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """Temporal Shift Module op (reference
+    ``nn/functional/extension.py:328``)."""
+    return _temporal_shift_raw(x, seg_num=int(seg_num),
+                               shift_ratio=float(shift_ratio),
+                               channel_last=(data_format == "NHWC"))
+
+
+def gather_tree(ids, parents):
+    """Walk beam-search parent pointers backward so every step holds the
+    full-path token (reference ``nn/functional/extension.py gather_tree``;
+    ids/parents: [max_time, batch, beam])."""
+    from ...ops.dispatch import apply_op
+
+    def fwd(ids_v, parents_v):
+        t_max = ids_v.shape[0]
+
+        def step(beams, t):
+            idx = t_max - 1 - t
+            gathered = jnp.take_along_axis(ids_v[idx], beams, axis=-1)
+            new_beams = jnp.take_along_axis(parents_v[idx], beams, axis=-1)
+            return new_beams, gathered
+
+        init = jnp.broadcast_to(
+            jnp.arange(ids_v.shape[-1], dtype=ids_v.dtype), ids_v.shape[1:])
+        _, rev = jax.lax.scan(step, init, jnp.arange(t_max))
+        return rev[::-1]
+
+    return apply_op("gather_tree", fwd, (ids, parents), {})
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample ``num_samples`` class centers always containing the positive
+    classes; remap labels into the sampled list (reference
+    ``nn/functional/common.py class_center_sample`` — PartialFC). Single
+    controller: the whole class range lives here, so the "per-rank class
+    section" is the full range."""
+    from ...ops.dispatch import apply_nondiff_op
+
+    key = rnd.next_key()
+
+    def fwd(lab):
+        pos = jnp.zeros((num_classes,), jnp.bool_).at[lab].set(True)
+        # rank positives first (stable), then randomly permuted negatives
+        noise = jax.random.uniform(key, (num_classes,))
+        order = jnp.argsort(jnp.where(pos, -1.0, noise))
+        sampled = jnp.sort(order[:num_samples])
+        # remap: position of each label inside `sampled` (present for all
+        # positives as long as num_samples >= #unique positives)
+        remap = jnp.zeros((num_classes,), lab.dtype).at[sampled].set(
+            jnp.arange(num_samples, dtype=lab.dtype))
+        return remap[lab], sampled
+
+    return apply_nondiff_op("class_center_sample", fwd, (label,))

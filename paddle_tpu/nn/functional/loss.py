@@ -5,7 +5,9 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from ... import ops
 from ...framework.tensor import Tensor
 from ...ops.dispatch import op, ensure_tensor
 
@@ -453,3 +455,149 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
     union = m.sum(input, axis=tuple(range(1, input.ndim))) + m.sum(lab, axis=tuple(range(1, lab.ndim)))
     dice = m.divide(m.multiply(inter, 2.0), m.add(union, epsilon))
     return m.mean(m.subtract(ensure_tensor(1.0, like=dice), dice))
+
+
+# -- round-4 API-audit additions --------------------------------------------
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """Per-class soft-margin BCE averaged over classes (reference
+    ``nn/functional/loss.py multi_label_soft_margin_loss``)."""
+    from ...ops.dispatch import apply_op
+
+    args = (input, label) if weight is None else (input, label, weight)
+
+    def fwd(x, y, w=None):
+        ls = jax.nn.log_sigmoid
+        per = -(y * ls(x) + (1.0 - y) * ls(-x))
+        if w is not None:
+            per = per * w
+        per = jnp.mean(per, axis=-1)
+        if reduction == "none":
+            return per
+        return jnp.sum(per) if reduction == "sum" else jnp.mean(per)
+
+    return apply_op("multi_label_soft_margin_loss", fwd, args, {})
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference ``nn/functional/loss.py triplet_margin_with_distance_loss``."""
+    from ...ops.dispatch import apply_op
+
+    if distance_function is not None:
+        # user metric operates on Tensors — compute eagerly through it
+        dp = distance_function(input, positive)
+        dn = distance_function(input, negative)
+        if swap:
+            dsn = distance_function(positive, negative)
+            dn = ops.minimum(dn, dsn)
+        loss = ops.clip(dp - dn + margin, min=0.0)
+        if reduction == "none":
+            return loss
+        return loss.sum() if reduction == "sum" else loss.mean()
+
+    def fwd(a, p, n):
+        def dist(u, v):
+            return jnp.sqrt(jnp.sum((u - v) ** 2, axis=-1) + 1e-12)
+
+        dp, dn = dist(a, p), dist(a, n)
+        if swap:
+            dn = jnp.minimum(dn, dist(p, n))
+        loss = jnp.maximum(dp - dn + margin, 0.0)
+        if reduction == "none":
+            return loss
+        return jnp.sum(loss) if reduction == "sum" else jnp.mean(loss)
+
+    return apply_op("triplet_margin_with_distance_loss", fwd,
+                    (input, positive, negative), {})
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference ``nn/functional/loss.py
+    hsigmoid_loss`` / ``phi/kernels hsigmoid``). Default tree: the complete
+    binary tree over ``num_classes`` leaves whose internal nodes are
+    heap-indexed (leaf ``c`` sits at heap position ``c + num_classes - 1``;
+    internal nodes 0..num_classes-2 own one weight row each); custom
+    ``path_table``/``path_code`` override it."""
+    from ...ops.dispatch import apply_op
+
+    import math as _math
+
+    depth = max(1, _math.ceil(_math.log2(max(2, num_classes))))
+    if path_table is None:
+        # precompute the (num_classes, depth) table on host: node ids along
+        # the root->leaf path (-1 pads short paths) and left/right codes
+        tab = np.full((num_classes, depth), -1, np.int32)
+        code = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for i, (nid, bit) in enumerate(reversed(path)):
+                tab[c, i] = nid
+                code[c, i] = bit
+        path_table_v, path_code_v = jnp.asarray(tab), jnp.asarray(code)
+    else:
+        path_table_v = jnp.asarray(
+            path_table._value if isinstance(path_table, Tensor) else path_table)
+        path_code_v = jnp.asarray(
+            path_code._value if isinstance(path_code, Tensor) else path_code)
+
+    args = (input, label, weight) if bias is None else (input, label, weight,
+                                                        bias)
+
+    def fwd(x, y, w, b=None):
+        nodes = path_table_v[y]                      # [N, D]
+        codes = path_code_v[y].astype(x.dtype)       # [N, D]
+        valid = (nodes >= 0).astype(x.dtype)
+        safe_nodes = jnp.maximum(nodes, 0)
+        wn = w[safe_nodes]                           # [N, D, F]
+        logits = jnp.einsum("nf,ndf->nd", x, wn)
+        if b is not None:
+            logits = logits + b.reshape(-1)[safe_nodes]
+        # per-node BCE with target = code; reference returns [N, 1]
+        per = jax.nn.softplus(logits) - codes * logits
+        return jnp.sum(per * valid, axis=-1, keepdims=True)
+
+    return apply_op("hsigmoid_loss", fwd, args, {})
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """Combined-margin (ArcFace-family) softmax CE over cosine logits
+    (reference ``nn/functional/loss.py:1701``): target-class logit becomes
+    ``cos(m1*theta + m2) - m3``, all logits scaled by ``scale``. Works on
+    the mp group's sharded classes in spmd contexts via the regular
+    parallel CE; single-controller path here operates on full logits."""
+    from ...ops.dispatch import apply_op
+
+    def fwd(lg, y):
+        y = y.reshape(-1)          # reference accepts [N] or [N, 1]
+        n, c = lg.shape
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, c, dtype=lg.dtype)
+        adj = (lg * (1.0 - onehot) + target * onehot) * scale
+        lse = jax.nn.logsumexp(adj, axis=-1)
+        picked = jnp.sum(adj * onehot, axis=-1)
+        loss = lse - picked
+        if reduction == "none":
+            loss_out = loss[:, None]
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = jnp.mean(loss)
+        if return_softmax:
+            return loss_out, jax.nn.softmax(adj, axis=-1)
+        return loss_out
+
+    return apply_op("margin_cross_entropy", fwd, (logits, label), {})

@@ -75,25 +75,150 @@ def _pool(kind, x, kernel_size, stride, padding, nd, data_format, ceil_mode=Fals
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
     df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
     out = _pool("max", x, kernel_size, stride, padding, 1, df, ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if not return_mask:
+        return out
+    return out, _pool_indices(x, kernel_size, stride, padding, 1, df,
+                              ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
     out = _pool("max", x, kernel_size, stride, padding, 2, data_format, ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if not return_mask:
+        return out
+    return out, _pool_indices(x, kernel_size, stride, padding, 2,
+                              data_format, ceil_mode)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool("max", x, kernel_size, stride, padding, 3, data_format, ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if not return_mask:
+        return out
+    return out, _pool_indices(x, kernel_size, stride, padding, 3,
+                              data_format, ceil_mode)
 
 
-def _pool_mask(x, out):
-    # indices of max within each window — approximation: not commonly needed;
-    # reference returns flattened spatial argmax indices.
-    from ...framework.tensor import Tensor
+@op("max_pool_indices")
+def _pool_indices_raw(x, ksize=(), stride=(), pads=(), nd=2,
+                      channel_last=False):
+    """Flat spatial argmax index per pooling window (reference return_mask
+    semantics: indices address the input's flattened spatial dims, in input
+    coordinates even with padding). Window elements are materialized with
+    ``conv_general_dilated_patches`` and argmax'd; padded positions carry
+    -inf so they are never selected."""
+    if channel_last:
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    # explicit -inf pad so padded cells never win the argmax
+    pad_widths = [(0, 0), (0, 0)] + [tuple(p) for p in pads]
+    xp = jnp.pad(x.astype(jnp.float32), pad_widths,
+                 constant_values=-jnp.inf)
+    xr = xp.reshape((n * c, 1) + xp.shape[2:])
+    patches = lax.conv_general_dilated_patches(
+        xr, filter_shape=tuple(ksize), window_strides=tuple(stride),
+        padding=[(0, 0)] * nd,
+    )
+    out_spatial = patches.shape[2:]
+    offs = jnp.argmax(patches, axis=1)          # (n*c, *out_spatial)
+    # unravel the within-window offset, map to input coords, flatten
+    flat = jnp.zeros_like(offs)
+    rem = offs
+    strides_total = 1
+    coords = []
+    for d in range(nd - 1, -1, -1):
+        coords.append(rem % ksize[d])
+        rem = rem // ksize[d]
+    coords = coords[::-1]
+    for d in range(nd):
+        grid = lax.broadcasted_iota(jnp.int32, offs.shape, 1 + d)
+        in_coord = grid * stride[d] - pads[d][0] + coords[d]
+        flat = flat * spatial[d] + in_coord
+    flat = flat.reshape((n, c) + out_spatial)
+    if channel_last:
+        flat = jnp.moveaxis(flat, 1, -1)
+    return flat.astype(jnp.int32)
 
-    return Tensor(jnp.zeros(out.shape, jnp.int32))
+
+def _pool_indices(x, kernel_size, stride, padding, nd, data_format,
+                  ceil_mode=False):
+    channel_last = data_format.endswith("C")
+    ks = _norm_tuple(kernel_size, nd)
+    st = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    pad_spec, _ = _norm_padding(padding, nd)
+    if isinstance(pad_spec, str):
+        pad_spec = ((0, 0),) * nd if pad_spec == "VALID" else None
+    if pad_spec is None:
+        raise ValueError("return_mask does not support SAME padding")
+    pads = [list(p) for p in pad_spec]
+    if ceil_mode:
+        # mirror _ceil_pad: grow the right pad so the mask tiles like the
+        # pooled output
+        sdims = (list(x.shape[1:-1]) if channel_last
+                 else list(x.shape[2:]))
+        for d in range(nd):
+            size = sdims[d] + pads[d][0] + pads[d][1]
+            rem = (size - ks[d]) % st[d]
+            if rem:
+                pads[d][1] += st[d] - rem
+    return _pool_indices_raw(
+        x, ksize=ks, stride=st, pads=tuple(tuple(p) for p in pads),
+        nd=nd, channel_last=channel_last)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, nd, data_format,
+            output_size):
+    """Scatter pooled values back to the argmax positions (reference
+    ``max_unpool{1,2,3}d``; the CUDA kernel is a scatter over the mask)."""
+    from ...ops.dispatch import apply_op
+
+    channel_last = data_format.endswith("C")
+    ks = _norm_tuple(kernel_size, nd)
+    st = _norm_tuple(stride if stride is not None else kernel_size, nd)
+    pd = _norm_tuple(padding, nd) if not isinstance(padding, (list, tuple))         else _norm_tuple(padding, nd)
+
+    def fwd(v, idx):
+        vv = jnp.moveaxis(v, -1, 1) if channel_last else v
+        ii = jnp.moveaxis(idx, -1, 1) if channel_last else idx
+        n, c = vv.shape[0], vv.shape[1]
+        in_spatial = vv.shape[2:]
+        if output_size is not None:
+            out_spatial = tuple(int(o) for o in output_size)[-nd:]
+        else:
+            out_spatial = tuple(
+                (in_spatial[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                for d in range(nd))
+        L = 1
+        for o in out_spatial:
+            L *= o
+        flat = jnp.zeros((n, c, L), vv.dtype)
+        bidx = lax.broadcasted_iota(jnp.int32, ii.shape, 0)
+        cidx = lax.broadcasted_iota(jnp.int32, ii.shape, 1)
+        flat = flat.at[bidx.reshape(n, c, -1),
+                       cidx.reshape(n, c, -1),
+                       ii.reshape(n, c, -1)].set(vv.reshape(n, c, -1))
+        out = flat.reshape((n, c) + out_spatial)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+    return apply_op("max_unpool%dd" % nd, fwd, (x, indices), {})
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 1,
+                   "NCW" if data_format in ("NCL", "NCW") else "NWC",
+                   output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 2, data_format,
+                   output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, 3, data_format,
+                   output_size)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):

@@ -10,6 +10,7 @@ __all__ = [
     "Sigmoid", "LogSigmoid", "Hardshrink", "Hardsigmoid", "Hardswish", "Hardtanh",
     "Softshrink", "Softplus", "Softsign", "Swish", "Mish", "Silu", "Tanh",
     "Tanhshrink", "ThresholdedReLU", "Softmax", "LogSoftmax", "Maxout", "GLU",
+    "Softmax2D",
 ]
 
 
@@ -193,3 +194,17 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self._axis)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (reference
+    ``nn/layer/activation.py Softmax2D``)."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3-D or 4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)

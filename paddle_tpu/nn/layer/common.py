@@ -31,6 +31,7 @@ __all__ = [
     "Fold",
     "Unfold",
     "Identity",
+    "PairwiseDistance",
 ]
 
 
@@ -280,3 +281,18 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance along the last dim (reference ``nn/layer/distance.py
+    PairwiseDistance``)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ... import ops
+
+        diff = x - y + self.epsilon
+        return ops.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)

@@ -3,6 +3,7 @@
 which lowers to XLA's TPU-native decompositions)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
@@ -224,6 +225,41 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         return Tensor(lu_), piv_t, info
     return Tensor(lu_), piv_t
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack ``lu``'s packed factorization into (P, L, U) (reference
+    ``tensor/linalg.py lu_unpack``; pivots are 1-based sequential row
+    transpositions, matching ``lu``'s output)."""
+    lu_v = lu_data._value
+    piv = lu_pivots._value.astype(jnp.int32) - 1   # back to 0-based
+    m, n = lu_v.shape[-2], lu_v.shape[-1]
+    k = min(m, n)
+    L = U = P = None
+    if unpack_ludata:
+        L = jnp.tril(lu_v[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v[..., :k, :])
+    if unpack_pivots:
+        def perm_of(pv):
+            def body(p, i):
+                j = pv[i]
+                pi, pj = p[i], p[j]
+                p = p.at[i].set(pj).at[j].set(pi)
+                return p, None
+
+            p0 = jnp.arange(m, dtype=jnp.int32)
+            p, _ = jax.lax.scan(body, p0, jnp.arange(pv.shape[-1]))
+            return p
+
+        flat_piv = piv.reshape((-1, piv.shape[-1]))
+        perms = jnp.stack([perm_of(pv) for pv in flat_piv], 0).reshape(
+            piv.shape[:-1] + (m,))
+        P = jax.nn.one_hot(perms, m, dtype=lu_v.dtype)
+        # rows of P: P[perm[i], i] = 1 so that A = P @ L @ U
+        P = jnp.swapaxes(P, -1, -2)
+    outs = [Tensor(v) if v is not None else None for v in (P, L, U)]
+    return tuple(outs)
 
 
 def corrcoef(x, rowvar=True, name=None):

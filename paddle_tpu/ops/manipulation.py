@@ -738,3 +738,36 @@ def crop(x, shape=None, offsets=None, name=None):
     offsets = _ints(offsets) if offsets is not None else [0] * x.ndim
     idx = tuple(builtins_slice(o, o + s if s != -1 else None) for o, s in zip(offsets, shape))
     return _getitem(x, idx)
+
+
+# -- round-4 API-audit additions (reference tensor/manipulation.py) ----------
+
+def reverse(x, axis, name=None):
+    """Reference ``fluid.layers.reverse`` — alias of flip."""
+    return flip(x, axis)
+
+
+def unbind(input, axis=0, name=None):
+    """Split along ``axis`` into a list with that dim removed (reference
+    ``tensor/manipulation.py unbind``)."""
+    return unstack(input, axis=int(axis))
+
+
+@op("shard_index")
+def _shard_index_raw(x, index_num=0, nshards=1, shard_id=0, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    in_shard = (x >= lo) & (x < lo + shard_size)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global indices to shard-local offsets, ``ignore_value`` outside
+    this shard (reference ``tensor/manipulation.py:485`` — the distributed
+    embedding / sharded-softmax label remap)."""
+    if shard_id < 0 or shard_id >= nshards:
+        raise ValueError(
+            f"shard_id({shard_id}) should be in [0, {nshards})")
+    return _shard_index_raw(input, index_num=int(index_num),
+                            nshards=int(nshards), shard_id=int(shard_id),
+                            ignore_value=int(ignore_value))

@@ -4,6 +4,7 @@ elementwise chains fuse into surrounding matmuls on the MXU automatically."""
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
@@ -461,3 +462,119 @@ def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     return apply_nondiff_op(
         "isclose", jnp.isclose, (x, y),
         {"rtol": rtol, "atol": atol, "equal_nan": equal_nan})
+
+
+# -- round-4 API-audit additions (reference python/paddle/tensor/math.py) ----
+
+@op("cross")
+def _cross_raw(x, y, axis=0):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=None, name=None):
+    """Cross product along ``axis`` (default: the first dim of length 3,
+    reference ``tensor/linalg.py cross``)."""
+    if axis is None:
+        axis = next(
+            (i for i, s in enumerate(x.shape) if s == 3), None)
+        if axis is None:
+            raise ValueError("cross: no dimension of length 3 found")
+    return _cross_raw(x, ensure_tensor(y, like=x), axis=int(axis))
+
+
+@op("diff")
+def _diff_raw(x, prepend=None, append=None, n=1, axis=-1):
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = prepend
+    if append is not None:
+        kw["append"] = append
+    return jnp.diff(x, n=n, axis=axis, **kw)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _diff_raw(x, prepend, append, n=int(n), axis=int(axis))
+
+
+@op("logcumsumexp")
+def _logcumsumexp_raw(x, axis=None):
+    if axis is None:
+        return lax.cumlogsumexp(jnp.reshape(x, (-1,)), axis=0)
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    out = _logcumsumexp_raw(x, axis=None if axis is None else int(axis))
+    from .manipulation import cast
+
+    return cast(out, dtype) if dtype is not None else out
+
+
+@op("renorm")
+def _renorm_raw(x, p=2.0, axis=0, max_norm=1.0):
+    ax = axis % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=red, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm,
+                      max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return x * scale
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along ``axis`` (reference
+    ``tensor/math.py renorm``)."""
+    return _renorm_raw(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+@op("tensordot")
+def _tensordot_raw(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    """Paddle axes semantics: int n = last n of x vs first n of y; a FLAT
+    list applies to BOTH tensors; [a_axes] likewise; [a_axes, b_axes]
+    pairs them (reference ``tensor/manipulation.py tensordot``)."""
+    from ..framework.tensor import Tensor as _T
+
+    if isinstance(axes, _T):
+        axes = np.asarray(axes._value).tolist()
+    if isinstance(axes, (list, tuple)):
+        seq = list(axes)
+        flat = True
+        for a in seq:
+            if isinstance(a, (list, tuple, np.ndarray, _T)):
+                flat = False  # builtins any/all are shadowed by paddle ops
+        if flat:
+            t = tuple(int(i) for i in seq)
+            axes = (t, t)
+        else:
+            subs = [tuple(int(i) for i in np.atleast_1d(
+                a._value if isinstance(a, _T) else a)) for a in seq]
+            axes = (subs[0], subs[0]) if len(subs) == 1 else (subs[0],
+                                                             subs[1])
+    else:
+        axes = int(axes)
+    return _tensordot_raw(x, ensure_tensor(y, like=x), axes=axes)
+
+
+def tanh_(x, name=None):
+    return x._rebind(tanh(x))
+
+
+def is_complex(x):
+    import jax.numpy as _jnp
+
+    return _jnp.issubdtype(x.dtype, _jnp.complexfloating)
+
+
+def is_floating_point(x):
+    import jax.numpy as _jnp
+
+    return _jnp.issubdtype(x.dtype, _jnp.floating)
+
+
+def is_integer(x):
+    import jax.numpy as _jnp
+
+    return _jnp.issubdtype(x.dtype, _jnp.integer)

@@ -1,0 +1,317 @@
+"""Round-4 API-surface audit additions: every name in the reference's
+``paddle``/``paddle.nn``/``paddle.nn.functional``/``paddle.linalg``/
+``paddle.distributed`` ``__all__`` now exists here — these tests pin the
+semantics of the newly added ones (torch-cpu as the oracle where its op
+matches the reference definition)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.utils import unique_name
+
+rng = np.random.RandomState(0)
+
+
+def t(x):
+    return Tensor(np.asarray(x))
+
+
+# -- tensor ops --------------------------------------------------------------
+
+def test_tensordot_paddle_axes_forms():
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32)
+    # flat list: contract the SAME axes of both tensors
+    got = paddle.tensordot(t(x), t(y), axes=[0, 1]).numpy()
+    np.testing.assert_allclose(got, (x * y).sum(), rtol=1e-5)
+    got = paddle.tensordot(t(x), t(y), axes=[[0, 1]]).numpy()
+    np.testing.assert_allclose(got, (x * y).sum(), rtol=1e-5)
+    z = rng.randn(4, 3).astype(np.float32)
+    got = paddle.tensordot(t(x), t(z), axes=[[0, 1], [1, 0]]).numpy()
+    np.testing.assert_allclose(got, np.tensordot(x, z, axes=([0, 1], [1, 0])),
+                               rtol=1e-5)
+
+
+def test_max_pool_mask_ceil_mode_shapes():
+    x = t(rng.randn(1, 1, 5, 5).astype(np.float32))
+    out, mask = F.max_pool2d(x, 2, 2, return_mask=True, ceil_mode=True)
+    assert list(out.shape) == list(mask.shape) == [1, 1, 3, 3]
+
+
+def test_margin_ce_label_column_shape():
+    logits = np.tanh(rng.randn(4, 10)).astype(np.float32)
+    label = rng.randint(0, 10, (4, 1))
+    out = F.margin_cross_entropy(t(logits), t(label), reduction="none")
+    assert list(out.shape) == [4, 1]
+
+
+def test_cross_diff_tensordot_unbind_reverse():
+    a = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(paddle.cross(t(a), t(b)).numpy(),
+                               np.cross(a, b), rtol=1e-6)
+    x = rng.randn(5, 7).astype(np.float32)
+    np.testing.assert_allclose(paddle.diff(t(x)).numpy(),
+                               np.diff(x), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.diff(t(x), n=2, axis=0).numpy(), np.diff(x, n=2, axis=0),
+        rtol=1e-6)
+    y = rng.randn(7, 6).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.tensordot(t(x), t(y), axes=1).numpy(),
+        np.tensordot(x, y, axes=1), rtol=1e-5)
+    parts = paddle.unbind(t(x), axis=1)
+    assert len(parts) == 7 and parts[0].shape == [5]
+    np.testing.assert_allclose(parts[3].numpy(), x[:, 3])
+    np.testing.assert_allclose(paddle.reverse(t(x), axis=[0]).numpy(),
+                               x[::-1])
+
+
+def test_logcumsumexp_and_renorm():
+    x = rng.randn(4, 6).astype(np.float32)
+    got = paddle.logcumsumexp(t(x), axis=1).numpy()
+    want = np.log(np.cumsum(np.exp(x), axis=1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    r = paddle.renorm(t(x), p=2.0, axis=0, max_norm=1.0).numpy()
+    norms = np.linalg.norm(r, axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+    # untouched rows keep their values
+    small = np.linalg.norm(x, axis=1) <= 1.0
+    np.testing.assert_allclose(r[small], x[small], rtol=1e-6)
+
+
+def test_shard_index():
+    label = t(np.array([[16], [1]], np.int64))
+    out = paddle.shard_index(label, index_num=20, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(out.numpy(), [[-1], [1]])
+    out1 = paddle.shard_index(label, index_num=20, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(out1.numpy(), [[6], [-1]])
+    with pytest.raises(ValueError):
+        paddle.shard_index(label, 20, 2, 5)
+
+
+def test_dtype_predicates_and_aliases():
+    assert paddle.is_floating_point(t(np.zeros(3, np.float32)))
+    assert not paddle.is_floating_point(t(np.zeros(3, np.int32)))
+    assert paddle.is_integer(t(np.zeros(3, np.int64)))
+    assert not paddle.is_complex(t(np.zeros(3, np.float32)))
+    assert paddle.is_complex(t(np.zeros(3, np.complex64)))
+    assert paddle.dtype("float32") == paddle.float32
+    assert paddle.bool == paddle.bool_
+    assert paddle.NPUPlace(0) is not None
+    paddle.check_shape([2, -1, 3])
+    with pytest.raises(TypeError):
+        paddle.check_shape([2, "x"])
+    paddle.disable_signal_handler()
+    st = paddle.get_cuda_rng_state()
+    paddle.set_cuda_rng_state(st)
+    xv = np.random.randn(3).astype(np.float32)
+    x = t(xv)
+    paddle.tanh_(x)
+    np.testing.assert_allclose(x.numpy(), np.tanh(xv), rtol=1e-6)
+
+
+# -- functional --------------------------------------------------------------
+
+def test_diag_embed_and_zeropad2d():
+    x = rng.randn(2, 3).astype(np.float32)
+    got = F.diag_embed(t(x)).numpy()
+    want = torch.diag_embed(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    got = F.diag_embed(t(x), offset=1).numpy()
+    want = torch.diag_embed(torch.tensor(x), offset=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    img = rng.randn(1, 2, 3, 4).astype(np.float32)
+    got = F.zeropad2d(t(img), [1, 2, 3, 4]).numpy()
+    want = tF.pad(torch.tensor(img), (1, 2, 3, 4)).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_temporal_shift():
+    x = rng.randn(4, 8, 2, 2).astype(np.float32)  # N*T=4 (T=2), C=8
+    out = F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25).numpy()
+    xr = x.reshape(2, 2, 8, 2, 2)
+    o = out.reshape(2, 2, 8, 2, 2)
+    fold = 2
+    # back-shift: segment t holds t+1's first fold channels
+    np.testing.assert_allclose(o[:, 0, :fold], xr[:, 1, :fold])
+    np.testing.assert_allclose(o[:, 1, :fold], 0.0)
+    # forward-shift: segment t holds t-1's second fold
+    np.testing.assert_allclose(o[:, 1, fold:2 * fold], xr[:, 0, fold:2 * fold])
+    np.testing.assert_allclose(o[:, 0, fold:2 * fold], 0.0)
+    np.testing.assert_allclose(o[:, :, 2 * fold:], xr[:, :, 2 * fold:])
+
+
+def test_max_pool_mask_and_unpool():
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(t(x), 2, 2, return_mask=True)
+    m, o = mask.numpy(), out.numpy()
+    for n in range(2):
+        for c in range(3):
+            for i in range(4):
+                for j in range(4):
+                    fi = m[n, c, i, j]
+                    assert x[n, c, fi // 8, fi % 8] == \
+                        x[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2].max()
+    un = F.max_unpool2d(out, mask, 2, 2).numpy()
+    want = tF.max_unpool2d(
+        *[torch.tensor(v) for v in
+          (o, m.astype(np.int64))], kernel_size=2, stride=2).numpy()
+    np.testing.assert_allclose(un, want)
+
+
+def test_losses_match_torch():
+    x = rng.randn(5, 7).astype(np.float32)
+    y = (rng.rand(5, 7) > 0.5).astype(np.float32)
+    got = F.multi_label_soft_margin_loss(t(x), t(y)).numpy()
+    want = tF.multilabel_soft_margin_loss(
+        torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    a, p, n = (rng.randn(4, 8).astype(np.float32) for _ in range(3))
+    got = F.triplet_margin_with_distance_loss(t(a), t(p), t(n),
+                                              margin=0.5).numpy()
+    want = tF.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n),
+        margin=0.5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_margin_cross_entropy_reduces_to_plain_ce():
+    # with margins (1, 0, 0) and scale s it's plain CE over s*logits
+    logits = np.tanh(rng.randn(6, 10)).astype(np.float32)
+    label = rng.randint(0, 10, (6,))
+    got = F.margin_cross_entropy(t(logits), t(label), margin1=1.0,
+                                 margin2=0.0, margin3=0.0, scale=4.0).numpy()
+    want = tF.cross_entropy(torch.tensor(logits * 4.0),
+                            torch.tensor(label)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # a real margin must increase the target-class loss
+    harder = F.margin_cross_entropy(t(logits), t(label), margin2=0.3,
+                                    scale=4.0).numpy()
+    assert harder > got
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(0)
+    with unique_name.guard():
+        layer = paddle.nn.HSigmoidLoss(16, num_classes=10)
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=layer.parameters())
+    x = t(rng.randn(32, 16).astype(np.float32))
+    y = t(rng.randint(0, 10, (32,)).astype(np.int64))
+    losses = []
+    for _ in range(25):
+        per = layer(x, y)
+        assert list(per.shape) == [32, 1]  # reference per-sample shape
+        loss = per.mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_class_center_sample():
+    label = t(np.array([2, 7, 7, 1], np.int64))
+    remapped, sampled = F.class_center_sample(label, num_classes=20,
+                                              num_samples=6)
+    s = sampled.numpy()
+    assert len(s) == 6 and len(set(s.tolist())) == 6
+    for cls in (1, 2, 7):
+        assert cls in s
+    r = remapped.numpy()
+    for orig, rm in zip([2, 7, 7, 1], r):
+        assert s[rm] == orig
+
+
+def test_gather_tree():
+    # example from the reference docstring
+    ids = t(np.array([[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+                     np.int64))
+    parents = t(np.array([[[0, 0], [1, 1]], [[1, 0], [1, 0]],
+                          [[0, 0], [0, 1]]], np.int64))
+    out = F.gather_tree(ids, parents).numpy()
+    want = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]], [[0, 1], [9, 0]]])
+    np.testing.assert_array_equal(out, want)
+
+
+def test_pairwise_distance_and_softmax2d():
+    x = rng.randn(4, 6).astype(np.float32)
+    y = rng.randn(4, 6).astype(np.float32)
+    d = paddle.nn.PairwiseDistance(p=2.0)(t(x), t(y)).numpy()
+    want = torch.pairwise_distance(torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(d, want, rtol=1e-4)
+
+    img = rng.randn(2, 3, 4, 4).astype(np.float32)
+    sm = paddle.nn.Softmax2D()(t(img)).numpy()
+    np.testing.assert_allclose(sm.sum(axis=1), np.ones((2, 4, 4)),
+                               rtol=1e-5)
+
+
+def test_lu_unpack_reconstructs():
+    a = rng.randn(5, 5).astype(np.float32)
+    lu, piv = paddle.linalg.lu(t(a))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    rec = P.numpy() @ L.numpy() @ U.numpy()
+    np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+
+def test_beam_search_decoder_dynamic_decode():
+    """Deterministic toy LM: from any state, token (state+1) % V has the
+    highest logit — greedy path is 1,2,3,... until end_token."""
+    V, B, beams = 6, 2, 3
+
+    class ToyCell:
+        def __call__(self, inputs, states):
+            ids = inputs._value.astype(np.int64)
+            nxt = (ids + 1) % V
+            import jax.numpy as jnp
+            import jax
+            logits = jax.nn.one_hot(nxt, V) * 5.0
+            return Tensor(logits), {"h": Tensor(states["h"]._value + 1.0)}
+
+    dec = paddle.nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=4,
+                                      beam_size=beams)
+    inits = {"h": t(np.zeros((B, 1), np.float32))}
+    out, final = paddle.nn.dynamic_decode(dec, inits=inits, max_step_num=10)
+    ids = out.numpy()  # [batch, time, beam]
+    assert ids.shape[0] == B and ids.shape[2] == beams
+    # best beam follows 1,2,3,4 then pads with the end token while the
+    # other beams drain
+    np.testing.assert_array_equal(ids[0, :4, 0], [1, 2, 3, 4])
+    assert (ids[0, 4:, 0] == 4).all()
+
+
+def test_distributed_shims():
+    import paddle_tpu.distributed as dist
+
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    dist.gloo_barrier()
+    dist.gloo_release()
+    with pytest.raises(RuntimeError, match="descoped"):
+        dist.InMemoryDataset()
+    with pytest.raises(RuntimeError, match="descoped"):
+        dist.QueueDataset()
+    assert hasattr(dist.launch, "launch")
+
+
+def test_distributed_split_linear():
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs["mp_degree"] = 2
+    fleet.init(is_collective=True, strategy=strategy)
+    with unique_name.guard():
+        paddle.seed(0)
+        x = t(rng.randn(4, 8).astype(np.float32))
+        out = paddle.distributed.split(x, (8, 6), "linear", axis=1,
+                                       gather_out=True)
+    assert list(out.shape) == [4, 6]
+    assert np.isfinite(out.numpy()).all()
