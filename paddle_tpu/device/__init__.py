@@ -167,3 +167,53 @@ def _mem_stats():
 
 
 cuda = _CudaNamespace()
+
+
+# -- round-4 API audit: compiled-with predicates + vendor places -------------
+
+def get_cudnn_version():
+    """No cuDNN on the TPU build (reference returns None when CUDA-less)."""
+    return None
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    """True in spirit: the accelerator backend here IS the XLA device."""
+    return False
+
+
+from ..framework.place import NPUPlace, XPUPlace  # noqa: F401,E402
+
+
+class IPUPlace(TPUPlace):
+    """Reference compat: maps to the accelerator place."""
+
+
+class MLUPlace(TPUPlace):
+    """Reference compat: maps to the accelerator place."""
+
+
+__all__ += [
+    "get_cudnn_version", "is_compiled_with_cinn", "is_compiled_with_ipu",
+    "is_compiled_with_mlu", "is_compiled_with_npu", "is_compiled_with_rocm",
+    "is_compiled_with_xpu", "IPUPlace", "MLUPlace", "XPUPlace", "NPUPlace",
+]

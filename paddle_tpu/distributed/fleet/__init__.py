@@ -14,6 +14,15 @@ from __future__ import annotations
 from .base.distributed_strategy import DistributedStrategy
 from .base.fleet_base import Fleet, fleet
 from . import utils  # noqa: F401  (fleet.utils.recompute)
+from ..topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from .role_maker import (  # noqa: F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+    PaddleCloudRoleMaker,
+    Role,
+    UserDefinedRoleMaker,
+    UtilBase,
+)
 
 # module-level singleton API (reference exposes `paddle.distributed.fleet.*`)
 init = fleet.init
@@ -38,4 +47,12 @@ __all__ = [
     "is_first_worker",
     "barrier_worker",
     "get_hybrid_communicate_group",
+    "CommunicateTopology",
+    "HybridCommunicateGroup",
+    "Role",
+    "PaddleCloudRoleMaker",
+    "UserDefinedRoleMaker",
+    "UtilBase",
+    "MultiSlotDataGenerator",
+    "MultiSlotStringDataGenerator",
 ]

@@ -1,0 +1,142 @@
+"""Role makers + fleet util surface (reference
+``distributed/fleet/base/role_maker.py`` / ``util_factory.py`` /
+``data_generator``).
+
+TPU-native: role discovery reads the launch CLI's PADDLE_* env surface
+(one worker role per process; PS roles are descoped per SURVEY §7). The
+MultiSlot data generators are faithful, framework-independent text-pipe
+formatters (they are pure python in the reference too)."""
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = [
+    "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker", "UtilBase",
+    "MultiSlotDataGenerator", "MultiSlotStringDataGenerator",
+]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Collective role maker over the PADDLE_* env (reference
+    ``role_maker.py PaddleCloudRoleMaker`` in collective mode)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+    def _worker_num(self):
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def _is_worker(self):
+        return True
+
+    def _is_server(self):
+        return False
+
+    def _role(self):
+        return Role.WORKER
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+    is_worker = _is_worker
+    is_server = _is_server
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """reference ``role_maker.py UserDefinedRoleMaker``."""
+
+    def __init__(self, is_collective=True, init_gloo=False, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._kwargs = kwargs
+
+    def _worker_index(self):
+        return int(self._kwargs.get(
+            "current_id", os.environ.get("PADDLE_TRAINER_ID", 0)))
+
+    def _worker_num(self):
+        return int(self._kwargs.get(
+            "worker_num", os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+
+    worker_index = _worker_index
+    worker_num = _worker_num
+
+
+class UtilBase:
+    """reference ``util_factory.py UtilBase``: small cross-rank helpers."""
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously over workers (reference
+        ``get_file_shard``)."""
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        n = len(files)
+        base, rem = divmod(n, world)
+        start = rank * base + min(rank, rem)
+        end = start + base + (1 if rank < rem else 0)
+        return list(files[start:end])
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ...framework.tensor import Tensor
+        from ..collective import ReduceOp, all_reduce
+
+        t = input if isinstance(input, Tensor) else Tensor(np.asarray(input))
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}[mode]
+        return all_reduce(t, op=op)
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier
+
+        return barrier()
+
+    def print_on_rank(self, message, rank_id=0):
+        if int(os.environ.get("PADDLE_TRAINER_ID", 0)) == rank_id:
+            print(message)
+
+
+class MultiSlotDataGenerator:
+    """reference ``fleet/data_generator``: turn raw lines into the
+    multi-slot text protocol ``slot:feasign_num:feasign...``. Subclass and
+    implement ``generate_sample``; ``run_from_stdin`` streams."""
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format(self, sample):
+        parts = []
+        for name, feas in sample:
+            parts.append(str(name))
+            parts.append(str(len(feas)))
+            parts.extend(str(f) for f in feas)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._format(sample) + "\n")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                out.append(self._format(sample))
+        return out
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-feature variant (reference keeps features as strings)."""

@@ -30,10 +30,13 @@ from .transform import (  # noqa: F401
     Transform,
 )
 
+from .distribution import ExponentialFamily  # noqa: F401
+
 __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Beta", "Dirichlet",
     "Multinomial", "Independent", "TransformedDistribution",
     "kl_divergence", "register_kl", "Transform", "AbsTransform",
     "AffineTransform", "ChainTransform", "ExpTransform", "PowerTransform",
     "SigmoidTransform", "SoftmaxTransform", "TanhTransform",
+    "ExponentialFamily",
 ]

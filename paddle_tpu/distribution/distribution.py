@@ -71,3 +71,46 @@ class Distribution:
 
     def __repr__(self):
         return f"{type(self).__name__}(batch_shape={self.batch_shape}, event_shape={self.event_shape})"
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    ``distribution/exponential_family.py``): subclasses expose natural
+    parameters + log-normalizer; ``entropy`` falls out via the Bregman
+    identity (autodiff of the log-normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError
+
+    def entropy(self):
+        """-H = sum(eta_i * dA/deta_i) - A + E[carrier] (reference
+        ``exponential_family.py entropy`` via autodiff)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.tensor import Tensor
+
+        nat = [p._value if isinstance(p, Tensor) else jnp.asarray(p)
+               for p in self._natural_parameters]
+
+        def logA(*ps):
+            out = self._log_normalizer(*[Tensor(p) for p in ps])
+            out = out._value if isinstance(out, Tensor) else out
+            return jnp.sum(out)
+
+        grads = jax.grad(logA, argnums=tuple(range(len(nat))))(*nat)
+        logn = self._log_normalizer(
+            *[Tensor(p) for p in nat])
+        logn = logn._value if isinstance(logn, Tensor) else logn
+        ent = -self._mean_carrier_measure + logn
+        for p, g in zip(nat, grads):
+            ent = ent - p * g
+        return Tensor(-(-ent))

@@ -8,3 +8,16 @@ from . import asp  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import multiprocessing  # noqa: F401
 from .optimizer import DistributedFusedLamb, LookAhead, ModelAverage  # noqa: F401
+from .graph_ops import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    identity_loss,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)

@@ -1,0 +1,205 @@
+"""Segment reductions + graph message passing + fused softmax masks
+(reference ``python/paddle/incubate/tensor/math.py`` segment ops,
+``incubate/operators/graph_send_recv.py`` and friends,
+``incubate/operators/softmax_mask_fuse*.py``).
+
+TPU-native: segment reductions ARE ``jax.ops.segment_*`` (sorted or not);
+graph sampling runs host-side on numpy (it is data preparation, like the
+reference's CPU kernels)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.dispatch import apply_nondiff_op, apply_op
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "graph_send_recv", "graph_reindex", "graph_sample_neighbors",
+    "graph_khop_sampler", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "identity_loss",
+]
+
+
+def _num_segments(segment_ids):
+    return int(np.asarray(
+        segment_ids._value if isinstance(segment_ids, Tensor)
+        else segment_ids).max()) + 1
+
+
+def _segment(kind, data, segment_ids, n):
+    fns = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+           "max": jax.ops.segment_max}
+
+    def fwd(d, ids):
+        if kind == "mean":
+            s = jax.ops.segment_sum(d, ids, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(ids, d.dtype), ids,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+        return fns[kind](d, ids, num_segments=n)
+
+    return apply_op(f"segment_{kind}", fwd, (data, segment_ids), {})
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("sum", data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("mean", data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("min", data, segment_ids, _num_segments(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("max", data, segment_ids, _num_segments(segment_ids))
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Gather features at ``src_index``, reduce onto ``dst_index``
+    (reference ``graph_send_recv.py:22``)."""
+    n = int(out_size) if out_size is not None else x.shape[0]
+    kind = pool_type.lower()
+
+    def fwd(xv, si, di):
+        msgs = xv[si]
+        if kind == "mean":
+            s = jax.ops.segment_sum(msgs, di, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones_like(di, xv.dtype), di,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1).reshape(
+                (-1,) + (1,) * (xv.ndim - 1))
+        fn = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+              "max": jax.ops.segment_max}[kind]
+        out = fn(msgs, di, num_segments=n)
+        if kind in ("min", "max"):
+            # empty segments: reference emits 0, segment_min/max emit +-inf
+            c = jax.ops.segment_sum(jnp.ones_like(di, jnp.int32), di,
+                                    num_segments=n)
+            out = jnp.where((c > 0).reshape(
+                (-1,) + (1,) * (xv.ndim - 1)), out, 0)
+        return out
+
+    return apply_op("graph_send_recv", fwd, (x, src_index, dst_index), {})
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to contiguous local ids (reference
+    ``incubate/operators/graph_reindex.py``): returns (reindexed_src,
+    reindexed_dst, out_nodes) where out_nodes = unique center+neighbor
+    nodes in first-seen order."""
+    xs = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    ct = np.asarray(count._value if isinstance(count, Tensor) else count)
+    order = {}
+    for v in list(xs) + list(nb):
+        v = int(v)
+        if v not in order:
+            order[v] = len(order)
+    out_nodes = np.fromiter(order.keys(), dtype=xs.dtype)
+    src = np.array([order[int(v)] for v in nb], dtype=np.int64)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), \
+        Tensor(jnp.asarray(out_nodes))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Sample up to ``sample_size`` in-neighbors per input node from a CSC
+    graph (reference ``incubate/operators/graph_sample_neighbors.py``).
+    Host-side numpy (data preparation, like the reference CPU kernel)."""
+    from ..framework import random as rnd
+
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._value
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    seed = int(np.asarray(
+        jax.random.randint(rnd.next_key(), (), 0, 2**31 - 1)))
+    g = np.random.RandomState(seed)
+    out, counts, out_eids = [], [], []
+    ev = (np.asarray(eids._value if isinstance(eids, Tensor) else eids)
+          if eids is not None else None)
+    for nid in nodes:
+        lo, hi = int(cp[nid]), int(cp[nid + 1])
+        idx = np.arange(lo, hi)
+        if sample_size >= 0 and len(idx) > sample_size:
+            idx = g.choice(idx, size=sample_size, replace=False)
+        out.extend(r[idx].tolist())
+        counts.append(len(idx))
+        if ev is not None:
+            out_eids.extend(ev[idx].tolist())
+    neigh = Tensor(jnp.asarray(np.array(out, r.dtype)))
+    cnt = Tensor(jnp.asarray(np.array(counts, np.int32)))
+    if return_eids:
+        return neigh, cnt, Tensor(jnp.asarray(np.array(out_eids)))
+    return neigh, cnt
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (reference
+    ``incubate/operators/graph_khop_sampler.py``)."""
+    cur = input_nodes
+    all_neigh, all_cnt = [], []
+    frontier = cur
+    for size in sample_sizes:
+        neigh, cnt = graph_sample_neighbors(row, colptr, frontier,
+                                            sample_size=size)
+        all_neigh.append(neigh)
+        all_cnt.append(cnt)
+        frontier = neigh
+    neighbors = Tensor(jnp.concatenate([n._value for n in all_neigh]))
+    counts = Tensor(jnp.concatenate([c._value for c in all_cnt]))
+    # centers for reindex: the concatenated frontiers aligned with counts
+    centers = Tensor(jnp.concatenate(
+        [jnp.asarray(np.asarray(c._value if isinstance(c, Tensor) else c))
+         for c in ([input_nodes] + all_neigh[:-1])]))
+    src, dst, nodes = graph_reindex(centers, neighbors, counts)
+    return src, dst, nodes, counts
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one pass (reference
+    ``incubate/operators/softmax_mask_fuse.py`` — the CUDA kernel fuses;
+    XLA fuses this composition on TPU by construction)."""
+
+    def fwd(xv, mv):
+        return jax.nn.softmax(xv + mv, axis=-1)
+
+    return apply_op("softmax_mask_fuse", fwd, (x, mask), {})
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal; reference
+    ``softmax_mask_fuse_upper_triangle.py``)."""
+
+    def fwd(xv):
+        q, k = xv.shape[-2], xv.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (q, k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (q, k), 1)
+        masked = jnp.where(cols <= rows, xv, -1e30)
+        return jax.nn.softmax(masked, axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", fwd, (x,), {})
+
+
+def identity_loss(x, reduction="none"):
+    """reference ``incubate/identity_loss``: mark a value as the loss
+    (IPU-era marker); reduces per ``reduction``."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()

@@ -29,3 +29,43 @@ class ProgramTranslator:
 
     def enable(self, flag=True):
         dy2static.enable(flag)
+
+_CODE_LEVEL = [0]
+
+
+def set_code_level(level=100):
+    """reference ``jit/logging_utils set_code_level``: controls how much
+    dy2static-transformed code is printed."""
+    _CODE_LEVEL[0] = int(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference ``jit/logging_utils set_verbosity``."""
+    _CODE_LEVEL[0] = int(level)
+
+
+class TracedLayer:
+    """reference ``fluid/dygraph/jit.py TracedLayer``: a traced module you
+    can call and save (here: a thin adapter over jit.save's traced
+    artifact)."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        outs = layer(*inputs)
+        return outs, tl
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        from .save_load import InputSpec, save
+
+        specs = [InputSpec(list(i.shape), str(i.dtype).split(".")[-1])
+                 for i in self._inputs]
+        save(self._layer, path, input_spec=specs)
+        return path

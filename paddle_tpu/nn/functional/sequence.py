@@ -2,6 +2,7 @@
 batches are padding+mask based on TPU, see SURVEY.md §7 'Dynamic shapes')."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...framework import dtype as dtypes
@@ -13,3 +14,60 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     m = int(maxlen) if maxlen is not None else int(lv.max())
     mask = jnp.arange(m) < lv[..., None]
     return Tensor(mask.astype(dtypes.convert_dtype(dtype)))
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decoding of CRF emission potentials (reference
+    ``text/viterbi_decode.py`` / ``phi/kernels viterbi_decode``).
+
+    potentials: [B, L, N]; transition_params: [N, N]; lengths: [B].
+    With ``include_bos_eos_tag`` the last two tags are BOS/EOS: step 0
+    scores add ``trans[BOS, tag]`` and the final step adds
+    ``trans[tag, EOS]``. Returns (scores [B], paths [B, L_max])."""
+    from ...ops.dispatch import apply_op
+
+    def fwd(pot, trans, lens):
+        b, t_max, n = pot.shape
+        if include_bos_eos_tag:
+            bos, eos = n - 2, n - 1
+            # BOS/EOS are virtual: no step may EMIT them
+            tag_mask = jnp.full((n,), 0.0).at[bos].set(-1e30).at[eos].set(
+                -1e30)
+            pot = pot + tag_mask[None, None, :]
+            start = pot[:, 0] + trans[bos][None, :]
+        else:
+            start = pot[:, 0]
+
+        def step(carry, t):
+            score, _ = carry
+            # score: [B, N]; expand over next tag
+            cand = score[:, :, None] + trans[None, :, :] + pot[:, t][:, None, :]
+            best_prev = jnp.argmax(cand, axis=1)          # [B, N]
+            new_score = jnp.max(cand, axis=1)
+            # sequences already ended keep their score frozen
+            alive = (t < lens)[:, None]
+            new_score = jnp.where(alive, new_score, score)
+            return (new_score, t), (best_prev, alive)
+
+        (final_score, _), (backptrs, alives) = jax.lax.scan(
+            step, (start, jnp.int32(0)), jnp.arange(1, t_max))
+        if include_bos_eos_tag:
+            final_score = final_score + trans[:, eos][None, :]
+        last_tag = jnp.argmax(final_score, axis=-1)       # [B]
+        scores = jnp.max(final_score, axis=-1)
+
+        def back(carry, inp):
+            tag = carry
+            bp, alive = inp
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            tag_out = jnp.where(alive[:, 0], prev, tag)
+            return tag_out, tag
+
+        first, rev_path = jax.lax.scan(back, last_tag, (backptrs, alives),
+                                       reverse=True)
+        paths = jnp.concatenate([first[None], rev_path], axis=0)
+        return scores, jnp.moveaxis(paths, 0, 1).astype(jnp.int64)
+
+    return apply_op("viterbi_decode", fwd,
+                    (potentials, transition_params, lengths), {})

@@ -29,6 +29,8 @@ from ..jit.save_load import InputSpec  # noqa: F401  (reference static/input.py)
 from .backward import append_backward
 from .io import save_inference_model, load_inference_model
 from . import nn
+from .compat import *  # noqa: F401,F403
+from . import compat  # noqa: F401
 
 __all__ = [
     "Program", "Variable", "data", "default_main_program",

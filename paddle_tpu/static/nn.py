@@ -244,3 +244,743 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
             return lax.switch(i, [_wrap_branch(f) for f in fns], ())
 
     return apply_op("switch_case", fwd, tuple([branch_index] + hidden), {})
+
+
+# ---------------------------------------------------------------------------
+# Legacy fluid-style layer functions (reference ``python/paddle/static/nn``).
+# Each builds the matching nn.Layer (parameters created eagerly, exactly the
+# LayerHelper role) and applies it — in static mode the CALL records into the
+# Program while the params live in the startup scope, mirroring the
+# reference split. Sequence ops follow the TPU build's dense+lengths
+# contract (LoD is a fluid-era CPU construct; dense padded tensors + masks
+# are the XLA-native representation).
+# ---------------------------------------------------------------------------
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    from ..nn import Conv2D
+
+    layer = Conv2D(int(input.shape[1 if data_format == "NCHW" else -1]),
+                   num_filters, filter_size, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    return _act(out, act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None):
+    from ..nn import Conv2DTranspose
+
+    layer = Conv2DTranspose(
+        int(input.shape[1 if data_format == "NCHW" else -1]), num_filters,
+        filter_size, stride=stride, padding=padding, dilation=dilation,
+        groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCDHW", name=None):
+    from ..nn import Conv3D
+
+    layer = Conv3D(int(input.shape[1 if data_format == "NCDHW" else -1]),
+                   num_filters, filter_size, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None):
+    from ..nn import Conv3DTranspose
+
+    layer = Conv3DTranspose(
+        int(input.shape[1 if data_format == "NCDHW" else -1]), num_filters,
+        filter_size, stride=stride, padding=padding, dilation=dilation,
+        groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    return _act(layer(input), act)
+
+
+def _act(out, act):
+    if act is None:
+        return out
+    import paddle_tpu.nn.functional as F
+
+    return getattr(F, act)(out)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn import BatchNorm2D, BatchNorm1D
+
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    cls = BatchNorm2D if len(input.shape) == 4 else BatchNorm1D
+    layer = cls(c, momentum=momentum, epsilon=epsilon,
+                weight_attr=param_attr, bias_attr=bias_attr,
+                data_format=data_layout if len(input.shape) == 4 else "NCL")
+    if is_test or use_global_stats:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    from ..nn import LayerNorm
+
+    shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from ..nn import GroupNorm
+
+    c = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = GroupNorm(groups, c, epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr,
+                      data_format=data_layout)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..nn import InstanceNorm2D
+
+    layer = InstanceNorm2D(int(input.shape[1]), epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              enable_scale_and_shift=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_rate=0.9999999, sync_stats=False):
+    """reference data_norm (CTR models): normalization by batch summaries
+    — statistics are detached (the reference treats the summaries as
+    non-differentiable accumulators) — with optional learned scale/shift
+    parameters when ``enable_scale_and_shift``."""
+    from ..nn.layer.layers import Layer
+    from ..ops.dispatch import apply_op
+
+    d = int(input.shape[-1])
+    scale = shift = None
+    if enable_scale_and_shift:
+        helper = Layer()
+        scale = helper.create_parameter([d], attr=param_attr)
+        shift = helper.create_parameter([d], attr=param_attr, is_bias=True)
+
+    def fwd(x, sc=None, sh=None):
+        import jax
+        import jax.numpy as jnp
+
+        mean = jax.lax.stop_gradient(jnp.mean(x, axis=0, keepdims=True))
+        var = jax.lax.stop_gradient(jnp.var(x, axis=0, keepdims=True))
+        y = (x - mean) / jnp.sqrt(var + epsilon)
+        if sc is not None:
+            y = y * sc + sh
+        return y
+
+    args = (input,) if scale is None else (input, scale, shift)
+    out = apply_op("data_norm", fwd, args, {})
+    return _act(out, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    from ..nn import Embedding
+
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      sparse=is_sparse, weight_attr=param_attr)
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """reference sparse_embedding (PS lookup table): on the TPU build this
+    is the SelectedRows-grad embedding (sparse=True)."""
+    return embedding(input, size, is_sparse=True, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from ..nn import PReLU
+
+    num = 1
+    if mode == "channel":
+        num = int(x.shape[1 if data_format == "NCHW" else -1])
+    elif mode == "element":
+        import numpy as _np
+
+        num = int(_np.prod(x.shape[1:]))
+    layer = PReLU(num_parameters=num, weight_attr=param_attr,
+                  data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference static spectral_norm: returns the spectrally normalized
+    weight (power iteration, like nn.utils.spectral_norm's estimate)."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(w):
+        import jax.numpy as jnp
+
+        mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype)
+        v = None
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+
+    return apply_op("spectral_norm", fwd, (weight,), {})
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    from ..nn import Bilinear
+
+    layer = Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    return _act(layer(x, y), act)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import DeformConv2D
+
+    layer = DeformConv2D(int(x.shape[1]), num_filters, filter_size,
+                         stride=stride, padding=padding, dilation=dilation,
+                         groups=groups, deformable_groups=deformable_groups,
+                         weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(x, offset, mask)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=5, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference static/nn/common.py
+    nce): per sample, the true class plus ``num_neg_samples`` uniform
+    negatives scored by a class-embedding matrix; returns per-sample NCE
+    loss [N, 1]."""
+    import numpy as _np
+
+    from ..framework import random as rnd
+    from ..framework.tensor import Tensor as _T
+    from ..nn.layer.layers import Layer
+    from ..ops.dispatch import apply_op
+
+    helper = Layer()
+    dim = int(input.shape[-1])
+    w = helper.create_parameter([num_total_classes, dim], attr=param_attr)
+    b = helper.create_parameter([num_total_classes], attr=bias_attr,
+                                is_bias=True)
+    key = rnd.next_key()
+
+    def fwd(x, y, wv, bv):
+        import jax
+        import jax.numpy as jnp
+
+        n = x.shape[0]
+        neg = jax.random.randint(key, (n, num_neg_samples), 0,
+                                 num_total_classes)
+        y2 = y.reshape(-1, 1)
+        cls = jnp.concatenate([y2, neg], axis=1)          # [N, 1+K]
+        logits = jnp.einsum("nd,nkd->nk", x, wv[cls]) + bv[cls]
+        labels = jnp.concatenate(
+            [jnp.ones((n, 1)), jnp.zeros((n, num_neg_samples))], axis=1)
+        per = (jax.nn.softplus(logits) - labels * logits).mean(axis=1)
+        return per.reshape(-1, 1)
+
+    return apply_op("nce", fwd, (input, label, w, b), {})
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference static/nn/control_flow.py case: first true predicate
+    wins."""
+
+    def build(pairs):
+        pred, fn = pairs[0]
+        rest = pairs[1:]
+        if not rest:
+            if default is None:
+                return fn()
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(rest))
+
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    return build(list(pred_fn_pairs))
+
+
+class StaticRNN:
+    """reference StaticRNN: build a per-timestep recurrence over [T, B, ...]
+    inputs. The TPU build executes the user-described step eagerly per
+    timestep (recording in static mode), which is exactly the reference's
+    unrolled-program semantics."""
+
+    def __init__(self, name=None):
+        self._inputs = []       # (tensor [T, B, ...])
+        self._memories = []     # dicts: init, var (current), next
+        self._outputs = []
+        self._built = False
+
+    def step(self):
+        import contextlib
+
+        return contextlib.nullcontext(self)
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        self._in_slots = getattr(self, "_in_slots", [])
+        slot = {"seq": x, "cur": None}
+        self._in_slots.append(slot)
+        return _SlotRef(slot)
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        from .. import ops
+
+        if init is None:
+            if batch_ref is None:
+                raise ValueError("memory needs init or batch_ref")
+            b = batch_ref.shape[ref_batch_dim_idx]
+            init = ops.full([b] + list(shape)[1:] if shape else [b],
+                            init_value, "float32")
+        slot = {"cur": init, "next": None, "init": init}
+        self._memories.append(slot)
+        return _SlotRef(slot)
+
+    def update_memory(self, mem_ref, new_val):
+        mem_ref._slot["next"] = new_val
+
+    def step_output(self, out):
+        self._out_ref = getattr(self, "_out_ref", [])
+        self._outputs.append(out)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise RuntimeError(
+            "StaticRNN on the TPU build is used through with rnn.step(): "
+            "build the step ONCE against SlotRefs, then call rnn.run()")
+
+    def run(self, step_fn, seq_len=None):
+        """Execute ``step_fn(t)`` per timestep; the user's closures read
+        SlotRefs. Returns stacked step outputs."""
+        from .. import ops
+
+        t_max = seq_len or int(self._in_slots[0]["seq"].shape[0])
+        outs = []
+        for t in range(t_max):
+            for slot in self._in_slots:
+                slot["cur"] = slot["seq"][t]
+            self._outputs = []
+            step_fn(t)
+            outs.append(self._outputs)
+            for m in self._memories:
+                if m["next"] is not None:
+                    m["cur"], m["next"] = m["next"], None
+        stacked = [ops.stack([o[i] for o in outs], axis=0)
+                   for i in range(len(outs[0]))]
+        return stacked[0] if len(stacked) == 1 else stacked
+
+
+class _SlotRef:
+    def __init__(self, slot):
+        self._slot = slot
+
+    def value(self):
+        return self._slot["cur"]
+
+    def __getattr__(self, name):
+        return getattr(self._slot["cur"], name)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None,
+                 transition=None, include_bos_eos_tag=True, name=None):
+    """reference crf_decoding: viterbi over CRF emissions. ``transition``
+    may be passed directly (the modern square [n_tags, n_tags] form, where
+    the last two tags are BOS/EOS when ``include_bos_eos_tag``) or owned
+    via param_attr; the emission width must equal the tag count."""
+    from .. import ops
+    from ..nn.functional.sequence import viterbi_decode
+    from ..nn.layer.layers import Layer
+
+    n = int(input.shape[-1])
+    if transition is None:
+        helper = Layer()
+        transition = helper.create_parameter([n, n], attr=param_attr)
+    if length is None:
+        length = ops.full([input.shape[0]], input.shape[1], "int64")
+    _, path = viterbi_decode(input, transition, length,
+                             include_bos_eos_tag=include_bos_eos_tag)
+    return path
+
+
+# -- dense+lengths sequence ops ---------------------------------------------
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Dense contract: x [B, T, ...] with ``length`` [B] — returns (padded,
+    length). (The reference consumes LoD; here padding is explicit.)"""
+    from .. import ops
+
+    if length is None:
+        raise ValueError("dense sequence_pad needs explicit length")
+    from ..nn.functional.sequence import sequence_mask
+
+    m = sequence_mask(length, maxlen=x.shape[1], dtype="bool")
+    while len(m.shape) < len(x.shape):
+        m = m.unsqueeze(-1)
+    out = ops.where(m, x, ops.full_like(x, float(pad_value)))
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """Returns the dense tensor with positions past ``length`` zeroed (the
+    dense stand-in for LoD compaction)."""
+    from .. import ops
+    from ..nn.functional.sequence import sequence_mask
+
+    m = sequence_mask(length, maxlen=x.shape[1], dtype="bool")
+    while len(m.shape) < len(x.shape):
+        m = m.unsqueeze(-1)
+    return ops.where(m, x, ops.zeros_like(x))
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None, name=None):
+    from .. import ops
+    from ..nn.functional.sequence import sequence_mask
+
+    x = input
+    if length is not None:
+        m = sequence_mask(length, maxlen=x.shape[1], dtype="float32")
+        while len(m.shape) < len(x.shape):
+            m = m.unsqueeze(-1)
+    else:
+        m = ops.ones_like(x)
+    pt = pool_type.lower()
+    if pt == "sum":
+        return (x * m).sum(axis=1)
+    if pt in ("average", "mean"):
+        return (x * m).sum(axis=1) / m.sum(axis=1).clip(min=1.0)
+    if pt == "sqrt":
+        return (x * m).sum(axis=1) / m.sum(axis=1).clip(min=1.0).sqrt()
+    if pt == "max":
+        neg = ops.full_like(x, -1e30)
+        return ops.where(m.astype("bool"), x, neg).max(axis=1)
+    if pt == "first":
+        return x[:, 0]
+    if pt == "last":
+        return sequence_last_step(x, length)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input, length=None):
+    return input[:, 0]
+
+
+def sequence_last_step(input, length=None):
+    from .. import ops
+
+    if length is None:
+        return input[:, -1]
+    idx = (length - 1).astype("int64")
+    return ops.stack([input[i, int(idx_i)] for i, idx_i in
+                      enumerate(idx.numpy().tolist())], axis=0) \
+        if not _is_traced(input) else _gather_time(input, idx)
+
+
+def _is_traced(x):
+    import jax
+
+    return isinstance(x._value, jax.core.Tracer)
+
+
+def _gather_time(x, idx):
+    from ..ops.dispatch import apply_op
+
+    def fwd(xv, iv):
+        import jax.numpy as jnp
+
+        sel = jnp.take_along_axis(
+            xv, iv.reshape((-1, 1) + (1,) * (xv.ndim - 2)).astype(
+                jnp.int32), axis=1)
+        return jnp.squeeze(sel, axis=1)
+
+    return apply_op("sequence_last_step", fwd, (x, idx), {})
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    from .. import ops
+    from ..nn.functional.sequence import sequence_mask
+
+    x = input
+    if length is not None:
+        m = sequence_mask(length, maxlen=x.shape[1], dtype="bool")
+        while len(m.shape) < len(x.shape):
+            m = m.unsqueeze(-1)
+        x = ops.where(m, x, ops.full_like(x, -1e30))
+    import paddle_tpu.nn.functional as F
+
+    return F.softmax(x, axis=1)
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse each sequence's VALID prefix (dense+lengths)."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(xv, lv=None):
+        import jax.numpy as jnp
+
+        t = xv.shape[1]
+        if lv is None:
+            return xv[:, ::-1]
+        pos = jnp.arange(t)[None, :]
+        src = jnp.where(pos < lv[:, None], lv[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(
+            xv, src.reshape(src.shape + (1,) * (xv.ndim - 2)).astype(
+                jnp.int32), axis=1)
+
+    args = (x,) if length is None else (x, length)
+    return apply_op("sequence_reverse", fwd, args, {})
+
+
+def sequence_concat(input, name=None):
+    """Dense contract: concatenate along time."""
+    from .. import ops
+
+    return ops.concat(input, axis=1)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Dense stand-in: tile x rows to match y's time dim."""
+    from .. import ops
+
+    reps = int(y.shape[1]) if len(y.shape) > 1 else 1
+    return ops.repeat_interleave(x, reps, axis=0)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):
+    from .. import ops
+
+    b = input.shape[0]
+    return ops.reshape(input, [b, -1, new_dim])
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """Sliding windows over time (reference sequence_enumerate)."""
+    from ..ops.dispatch import apply_op
+
+    def fwd(xv):
+        import jax.numpy as jnp
+
+        t = xv.shape[1]
+        outs = []
+        for w in range(win_size):
+            shifted = jnp.concatenate(
+                [xv[:, w:], jnp.full_like(xv[:, :w], pad_value)], axis=1)
+            outs.append(shifted)
+        return jnp.stack(outs, axis=-1)
+
+    return apply_op("sequence_enumerate", fwd, (input,), {})
+
+
+def sequence_pool_first(x):
+    return x[:, 0]
+
+
+def sequence_slice(input, offset, length, name=None):
+    from ..ops.dispatch import apply_op
+
+    def fwd(xv, off, ln):
+        import jax.numpy as jnp
+
+        t = xv.shape[1]
+        pos = jnp.arange(t)[None, :]
+        idx = (off.reshape(-1, 1) + pos) % t
+        keep = pos < ln.reshape(-1, 1)
+        sel = jnp.take_along_axis(
+            xv, idx.reshape(idx.shape + (1,) * (xv.ndim - 2)).astype(
+                jnp.int32), axis=1)
+        mask = keep.reshape(keep.shape + (1,) * (xv.ndim - 2))
+        return jnp.where(mask, sel, 0)
+
+    return apply_op("sequence_slice", fwd, (input, offset, length), {})
+
+
+def sequence_scatter(input, index, updates, name=None):
+    from ..ops.dispatch import apply_op
+
+    def fwd(xv, iv, uv):
+        import jax.numpy as jnp
+
+        b = jnp.arange(xv.shape[0]).reshape(-1, 1)
+        b = jnp.broadcast_to(b, iv.shape)
+        return xv.at[b, iv].add(uv)
+
+    return apply_op("sequence_scatter", fwd, (input, index, updates), {})
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over time (reference sequence_conv): dense form
+    is a Conv1D with same-padding over [B, T, C]."""
+    from ..nn import Conv1D
+
+    layer = Conv1D(int(input.shape[-1]), num_filters, filter_size,
+                   padding="SAME" if padding else 0, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format="NLC")
+    return _act(layer(input), act)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv / DeepSpeech2): each
+    timestep mixes the next ``future_context_size`` frames with learned
+    per-channel weights."""
+    from ..nn.layer.layers import Layer
+    from ..ops.dispatch import apply_op
+
+    helper = Layer()
+    d = int(input.shape[-1])
+    w = helper.create_parameter([future_context_size + 1, d],
+                                attr=param_attr)
+
+    def fwd(xv, wv):
+        import jax.numpy as jnp
+
+        t = xv.shape[1]
+        out = jnp.zeros_like(xv)
+        for k in range(future_context_size + 1):
+            shifted = jnp.concatenate(
+                [xv[:, k:], jnp.zeros_like(xv[:, :k])], axis=1)
+            out = out + shifted * wv[k][None, None, :]
+        return out
+
+    return _act(apply_op("row_conv", fwd, (input, w), {}), act)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-box head (reference static/nn/multi_box_head): per feature
+    map, a conv predicts box offsets and class scores over generated prior
+    boxes; returns (mbox_locs, mbox_confs, prior_boxes, variances)."""
+    import numpy as _np
+
+    from .. import ops
+    from ..nn import Conv2D
+
+    if min_sizes is None:
+        n = len(inputs)
+        step = int((max_ratio - min_ratio) / max(n - 2, 1))
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+
+    def _cell_sizes(i, ar):
+        """The per-cell (w, h) prior list — single source of truth for BOTH
+        the conv channel count and the generated boxes."""
+        sizes = [(min_sizes[i], min_sizes[i])]
+        if max_sizes:
+            s_ = _np.sqrt(min_sizes[i] * max_sizes[i])
+            sizes.append((s_, s_))
+        for a in ar:
+            if a == 1:
+                continue
+            w_ = min_sizes[i] * _np.sqrt(a)
+            h_ = min_sizes[i] / _np.sqrt(a)
+            sizes.append((w_, h_))
+            if flip:
+                sizes.append((h_, w_))
+        return sizes
+
+    locs, confs, priors_all, vars_all = [], [], [], []
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i]
+        n_priors = len(_cell_sizes(i, ar))
+        c_in = int(feat.shape[1])
+        loc_conv = Conv2D(c_in, n_priors * 4, kernel_size, padding=pad,
+                          stride=stride)
+        conf_conv = Conv2D(c_in, n_priors * num_classes, kernel_size,
+                           padding=pad, stride=stride)
+        loc = loc_conv(feat)
+        conf = conf_conv(feat)
+        b = int(feat.shape[0])
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([b, -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [b, -1, num_classes]))
+        # prior boxes on the host (static data, like the reference op)
+        fh, fw = int(feat.shape[2]), int(feat.shape[3])
+        sw = steps[i] if steps else img_w / fw
+        sh = steps[i] if steps else img_h / fh
+        boxes = []
+        for y in range(fh):
+            for x in range(fw):
+                cx, cy = (x + offset) * sw, (y + offset) * sh
+                for (bw, bh) in _cell_sizes(i, ar):
+                    box = [(cx - bw / 2) / img_w, (cy - bh / 2) / img_h,
+                           (cx + bw / 2) / img_w, (cy + bh / 2) / img_h]
+                    if clip:
+                        box = [min(max(v, 0.0), 1.0) for v in box]
+                    boxes.append(box)
+        pb = _np.asarray(boxes, _np.float32)
+        priors_all.append(ops.to_tensor(pb))
+        vars_all.append(ops.to_tensor(
+            _np.tile(_np.asarray(variance, _np.float32), (len(boxes), 1))))
+    mbox_locs = ops.concat(locs, axis=1)
+    mbox_confs = ops.concat(confs, axis=1)
+    boxes = ops.concat(priors_all, axis=0)
+    variances = ops.concat(vars_all, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+from ..static.compat import py_func  # noqa: E402,F401
+
+__all__ += [
+    "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "data_norm",
+    "embedding", "sparse_embedding", "prelu", "spectral_norm",
+    "bilinear_tensor_product", "deform_conv2d", "nce", "case", "StaticRNN",
+    "crf_decoding", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_softmax",
+    "sequence_reverse", "sequence_concat", "sequence_expand",
+    "sequence_expand_as", "sequence_reshape", "sequence_enumerate",
+    "sequence_slice", "sequence_scatter", "sequence_conv", "row_conv",
+    "multi_box_head", "py_func",
+]

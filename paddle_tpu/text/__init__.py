@@ -44,3 +44,110 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """SRL rows (synthetic offline surrogate): item = (word_ids, predicate,
+    mark, label_ids), the reference's tuple shape."""
+
+    def __init__(self, mode="train", seq_len=32, vocab=2000, labels=18,
+                 n=1024):
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        self.words = rng.randint(1, vocab, (n, seq_len)).astype(np.int64)
+        self.pred = rng.randint(1, vocab, (n,)).astype(np.int64)
+        self.mark = rng.randint(0, 2, (n, seq_len)).astype(np.int64)
+        self.labels = rng.randint(0, labels, (n, seq_len)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.words[i], self.pred[i], self.mark[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.words)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram rows (synthetic offline surrogate): item =
+    int64[n] context+target ids."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 vocab=2000, n=4096):
+        rng = np.random.RandomState(6 if mode == "train" else 7)
+        self.rows = rng.randint(1, vocab, (n, window_size)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return tuple(self.rows[i])
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class Movielens(Dataset):
+    """Rating rows (synthetic offline surrogate): item = (user_id, gender,
+    age, job, movie_id, category_ids, title_ids, rating)."""
+
+    def __init__(self, mode="train", n=4096):
+        rng = np.random.RandomState(8 if mode == "train" else 9)
+        self.user = rng.randint(1, 6041, (n,)).astype(np.int64)
+        self.gender = rng.randint(0, 2, (n,)).astype(np.int64)
+        self.age = rng.randint(0, 7, (n,)).astype(np.int64)
+        self.job = rng.randint(0, 21, (n,)).astype(np.int64)
+        self.movie = rng.randint(1, 3953, (n,)).astype(np.int64)
+        self.cat = rng.randint(0, 18, (n, 3)).astype(np.int64)
+        self.title = rng.randint(1, 5217, (n, 4)).astype(np.int64)
+        self.rating = rng.randint(1, 6, (n,)).astype(np.float32)
+
+    def __getitem__(self, i):
+        return (self.user[i], self.gender[i], self.age[i], self.job[i],
+                self.movie[i], self.cat[i], self.title[i], self.rating[i])
+
+    def __len__(self):
+        return len(self.user)
+
+
+class WMT14(Dataset):
+    """Translation pairs (synthetic offline surrogate): item = (src_ids,
+    trg_ids, trg_next_ids)."""
+
+    def __init__(self, mode="train", dict_size=3000, seq_len=24, n=2048):
+        rng = np.random.RandomState(10 if mode == "train" else 11)
+        self.src = rng.randint(1, dict_size, (n, seq_len)).astype(np.int64)
+        self.trg = rng.randint(1, dict_size, (n, seq_len)).astype(np.int64)
+
+    def __getitem__(self, i):
+        trg = self.trg[i]
+        return self.src[i], trg, np.roll(trg, -1)
+
+    def __len__(self):
+        return len(self.src)
+
+
+class WMT16(WMT14):
+    """Same tuple shape as WMT14 (synthetic offline surrogate)."""
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference ``text/viterbi_decode.py viterbi_decode`` — see
+    ``nn/functional/sequence.py`` for the kernel."""
+    from ..nn.functional.sequence import viterbi_decode as _vd
+
+    return _vd(potentials, transition_params, lengths,
+               include_bos_eos_tag=include_bos_eos_tag)
+
+
+class ViterbiDecoder:
+    """reference ``text/viterbi_decode.py ViterbiDecoder`` (a Layer in the
+    reference; stateless callable here — the transitions come in at call
+    construction)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+__all__ += ["Conll05st", "Imikolov", "Movielens", "WMT14", "WMT16",
+            "viterbi_decode", "ViterbiDecoder"]

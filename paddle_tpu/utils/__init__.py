@@ -3,3 +3,83 @@ from . import unique_name  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401
 from .log_writer import LogWriter, Monitor, get_monitor  # noqa: F401
+import functools as _functools
+import importlib as _importlib
+import warnings as _warnings
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference ``utils/deprecated.py``: decorator emitting a
+    DeprecationWarning (level 2 raises)."""
+
+    def deco(fn):
+        msg = (f"API {fn.__module__}.{fn.__name__} is deprecated"
+               + (f" since {since}" if since else "")
+               + (f", use {update_to} instead" if update_to else "")
+               + (f". Reason: {reason}" if reason else ""))
+
+        @_functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level == 1 or level == 0:
+                _warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """reference ``utils/install_check require_version``: check the
+    installed framework version (this build reports its own)."""
+    from ..version import full_version
+
+    def parse(v):
+        import re as _re
+
+        out = []
+        for part in str(v).split(".")[:3]:
+            m = _re.match(r"\d+", part)
+            out.append(int(m.group()) if m else 0)
+        while len(out) < 3:
+            out.append(0)
+        return tuple(out)
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > maximum {max_version}")
+
+
+def run_check():
+    """reference ``utils/install_check.run_check``: a tiny end-to-end
+    train step proving the install works on this device."""
+    import numpy as _np
+
+    from .. import nn, optimizer, to_tensor
+
+    from . import unique_name
+
+    with unique_name.guard():
+        lin = nn.Linear(4, 4)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        x = to_tensor(_np.ones((2, 4), _np.float32))
+        loss = lin(x).square().mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    print("PaddlePaddle(TPU build) is installed successfully!")
+
+
+def try_import(module_name, err_msg=None):
+    """reference ``utils/lazy_import.try_import``."""
+    try:
+        return _importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name!r}; install it first.")

@@ -2,3 +2,30 @@
 from . import datasets, models, transforms  # noqa: F401
 from . import ops  # noqa: F401
 from .models import LeNet, ResNet  # noqa: F401
+
+_IMAGE_BACKEND = ["pil"]
+
+
+def set_image_backend(backend):
+    """reference ``vision/image.py set_image_backend`` ('pil' | 'cv2')."""
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _IMAGE_BACKEND[0] = backend
+
+
+def get_image_backend():
+    return _IMAGE_BACKEND[0]
+
+
+def image_load(path, backend=None):
+    """reference ``vision/image.py image_load``: returns a PIL image (or a
+    cv2 ndarray when that backend is selected and installed)."""
+    backend = backend or _IMAGE_BACKEND[0]
+    if backend == "cv2":
+        from ..utils import try_import
+
+        cv2 = try_import("cv2")
+        return cv2.imread(path)
+    from PIL import Image
+
+    return Image.open(path)

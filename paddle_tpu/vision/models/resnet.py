@@ -161,3 +161,23 @@ def resnext50_32x4d(pretrained=False, **kwargs):
 
 def resnext101_64x4d(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
+
+
+__all__ += ["resnext50_64x4d", "resnext101_32x4d", "resnext152_32x4d",
+            "resnext152_64x4d"]

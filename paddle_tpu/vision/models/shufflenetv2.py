@@ -1,0 +1,135 @@
+"""ShuffleNet V2 (reference
+``python/paddle/vision/models/shufflenetv2.py``)."""
+from __future__ import annotations
+
+from ... import nn, ops
+
+__all__ = [
+    "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+    "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+    "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+]
+
+
+def _channel_shuffle(x, groups):
+    import paddle_tpu.nn.functional as F
+
+    return F.channel_shuffle(x, groups)
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, c_in, c_out, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = c_out // 2
+        if stride == 1:
+            self.b2 = nn.Sequential(
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+                nn.Conv2D(branch, branch, 3, stride=1, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+        else:
+            self.b1 = nn.Sequential(
+                nn.Conv2D(c_in, c_in, 3, stride=stride, padding=1,
+                          groups=c_in, bias_attr=False),
+                nn.BatchNorm2D(c_in),
+                nn.Conv2D(c_in, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+            self.b2 = nn.Sequential(
+                nn.Conv2D(c_in, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+                nn.Conv2D(branch, branch, 3, stride=stride, padding=1,
+                          groups=branch, bias_attr=False),
+                nn.BatchNorm2D(branch),
+                nn.Conv2D(branch, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = ops.concat([x1, self.b2(x2)], axis=1)
+        else:
+            out = ops.concat([self.b1(x), self.b2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        cfgs = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                1.5: [24, 176, 352, 704, 1024],
+                2.0: [24, 244, 488, 976, 2048]}
+        ch = cfgs[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, ch[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(ch[0]), _act(act))
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        c_in = ch[0]
+        for stage, reps in enumerate(stage_repeats):
+            c_out = ch[stage + 1]
+            for i in range(reps):
+                blocks.append(_InvertedResidual(
+                    c_in, c_out, stride=2 if i == 0 else 1, act=act))
+                c_in = c_out
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(c_in, ch[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(ch[-1]), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(ch[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

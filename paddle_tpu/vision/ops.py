@@ -279,3 +279,270 @@ class DeformConv2D(_Layer):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              stride=self._stride, padding=self._padding,
                              dilation=self._dilation, mask=mask)
+
+
+# -- round-4 API-audit additions --------------------------------------------
+
+import numpy as np  # noqa: E402
+
+Layer = _Layer
+
+
+class RoIAlign(Layer):
+    """Layer form of :func:`roi_align` (reference ``vision/ops.py
+    RoIAlign``)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._args[0],
+                         spatial_scale=self._args[1])
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._args[0],
+                        spatial_scale=self._args[1])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference ``vision/ops.py
+    psroi_pool`` — R-FCN): input channels C = out_c * ph * pw; output bin
+    (i, j) averages channel group (i*pw + j) inside its sub-window."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    c_in = int(x.shape[1])
+    if c_in % (ph * pw):
+        raise ValueError(
+            f"psroi_pool needs channels divisible by {ph * pw}, got {c_in}")
+    out_c = c_in // (ph * pw)
+    # reuse the averaged roi grid: pool each channel-group's sub-bin
+    pooled = roi_align(x, boxes, boxes_num, output_size,
+                       spatial_scale=spatial_scale, sampling_ratio=1,
+                       aligned=False)           # [R, C, ph, pw]
+
+    from ..ops.dispatch import apply_op
+
+    def fwd(p):
+        r = p.shape[0]
+        g = p.reshape(r, out_c, ph, pw, ph, pw)
+        # output bin (i, j) reads channel group (i, j)'s sub-bin (i, j)
+        return jnp.stack(
+            [jnp.stack([g[:, :, i, j, i, j] for j in range(pw)], -1)
+             for i in range(ph)], -2)
+
+    return apply_op("psroi_pool", fwd, (pooled,), {})
+
+
+class PSRoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._args[0],
+                          spatial_scale=self._args[1])
+
+
+def read_file(path, name=None):
+    """reference ``vision/ops.py read_file``: raw file bytes as a uint8
+    Tensor."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference ``vision/ops.py decode_jpeg``: JPEG bytes -> CHW uint8
+    Tensor (PIL backend — the reference uses nvjpeg on CUDA, a host decoder
+    elsewhere)."""
+    import io
+
+    from PIL import Image
+
+    data = bytes(np.asarray(x._value if isinstance(x, Tensor) else x,
+                            np.uint8))
+    img = Image.open(io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    elif img.mode == "P":
+        img = img.convert("RGB")  # palettes have no dense array form
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[None]
+    else:
+        a = np.transpose(a, (2, 0, 1))
+    return Tensor(jnp.asarray(a))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """YOLOv3 head decode (reference ``vision/ops.py yolo_box``): raw
+    feature map -> (boxes [N, H*W*na, 4] xyxy, scores [N, H*W*na, C])."""
+    from ..ops.dispatch import apply_op
+
+    na = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(na, 2)
+
+    def fwd(xv, imgs):
+        n, _, h, w = xv.shape
+        feat = xv.reshape(n, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[:, None]
+        sx = jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        sy = jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        cx = (sx + gx[None, None]) / w
+        cy = (sy + gy[None, None]) / h
+        anchors_w = jnp.asarray(anc[:, 0])[None, :, None, None]
+        anchors_h = jnp.asarray(anc[:, 1])[None, :, None, None]
+        bw = jnp.exp(feat[:, :, 2]) * anchors_w / (w * downsample_ratio)
+        bh = jnp.exp(feat[:, :, 3]) * anchors_h / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(feat[:, :, 4])
+        probs = jax.nn.sigmoid(feat[:, :, 5:])
+        scores = conf[:, :, None] * probs
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2.0) * img_w
+        y1 = (cy - bh / 2.0) * img_h
+        x2 = (cx + bw / 2.0) * img_w
+        y2 = (cy + bh / 2.0) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1)
+            y1 = jnp.clip(y1, 0.0, img_h - 1)
+            x2 = jnp.clip(x2, 0.0, img_w - 1)
+            y2 = jnp.clip(y2, 0.0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+        scores_out = jnp.moveaxis(scores, 2, -1).reshape(n, -1, class_num)
+        keep = (conf.reshape(n, -1) >= conf_thresh)[..., None]
+        return boxes * keep, scores_out * keep
+
+    return apply_op("yolo_box", fwd, (x, img_size), {})
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference ``vision/ops.py yolo_loss``):
+    coordinate + objectness + class terms over anchor-matched ground-truth
+    boxes, with high-IoU negatives ignored."""
+    from ..ops.dispatch import apply_op
+
+    na_all = len(anchors) // 2
+    anc_all = np.asarray(anchors, np.float32).reshape(na_all, 2)
+    mask = list(anchor_mask)
+    na = len(mask)
+
+    def fwd(xv, gb, gl):
+        n, _, h, w = xv.shape
+        feat = xv.reshape(n, na, 5 + class_num, h, w)
+        stride = downsample_ratio
+        in_h, in_w = h * stride, w * stride
+        tx = jax.nn.sigmoid(feat[:, :, 0])
+        ty = jax.nn.sigmoid(feat[:, :, 1])
+        tw, th = feat[:, :, 2], feat[:, :, 3]
+        obj_logit = feat[:, :, 4]
+        cls_logit = feat[:, :, 5:]
+
+        # build targets host-free: for each gt, the responsible cell +
+        # best-matching masked anchor
+        gx = gb[..., 0] * w                      # [n, B] grid coords
+        gy = gb[..., 1] * h
+        gw = gb[..., 2] * in_w
+        gh = gb[..., 3] * in_h
+        valid = (gb[..., 2] > 0) & (gb[..., 3] > 0)
+        # best anchor by shape IoU over ALL anchors, then keep if in mask
+        wa = jnp.asarray(anc_all[:, 0])[None, None, :]
+        ha = jnp.asarray(anc_all[:, 1])[None, None, :]
+        inter = jnp.minimum(gw[..., None], wa) * jnp.minimum(
+            gh[..., None], ha)
+        union = gw[..., None] * gh[..., None] + wa * ha - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+        mask_arr = jnp.asarray(mask)
+        in_mask = (best[..., None] == mask_arr[None, None, :])
+        a_local = jnp.argmax(in_mask, axis=-1)   # [n, B]
+        resp = valid & jnp.any(in_mask, axis=-1)
+
+        ci = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(ci)
+
+        def gathered(t):
+            return t[bidx, a_local, cj, ci]
+
+        # coordinate loss (responsible cells only)
+        anchor_w = jnp.asarray(anc_all[:, 0])[a_local]
+        anchor_h = jnp.asarray(anc_all[:, 1])[a_local]
+        tgt_tx = gx - jnp.floor(gx)
+        tgt_ty = gy - jnp.floor(gy)
+        tgt_tw = jnp.log(jnp.maximum(gw / anchor_w, 1e-9))
+        tgt_th = jnp.log(jnp.maximum(gh / anchor_h, 1e-9))
+        scale = 2.0 - gb[..., 2] * gb[..., 3]
+        rf = resp.astype(jnp.float32) * scale
+        loss_xy = jnp.sum(((gathered(tx) - tgt_tx) ** 2
+                           + (gathered(ty) - tgt_ty) ** 2) * rf, axis=1)
+        loss_wh = jnp.sum(((gathered(tw) - tgt_tw) ** 2
+                           + (gathered(th) - tgt_th) ** 2) * rf, axis=1)
+
+        # objectness: positives at responsible cells; negatives everywhere
+        # else EXCEPT cells whose predicted box IoUs any gt above
+        # ignore_thresh (excluded from the loss, reference semantics)
+        obj_t = jnp.zeros((n, na, h, w))
+        obj_t = obj_t.at[bidx, a_local, cj, ci].max(
+            resp.astype(jnp.float32))
+        gxc = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gyc = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        aw = jnp.asarray(anc_all[jnp.asarray(mask), 0])[None, :, None, None]
+        ah = jnp.asarray(anc_all[jnp.asarray(mask), 1])[None, :, None, None]
+        px = (tx + gxc) / w
+        py = (ty + gyc) / h
+        pw_ = jnp.exp(jnp.clip(tw, -10, 10)) * aw / in_w
+        ph_ = jnp.exp(jnp.clip(th, -10, 10)) * ah / in_h
+        px1, py1 = px - pw_ / 2, py - ph_ / 2
+        px2, py2 = px + pw_ / 2, py + ph_ / 2
+        gx1 = (gb[..., 0] - gb[..., 2] / 2)[:, None, None, None, :]
+        gy1 = (gb[..., 1] - gb[..., 3] / 2)[:, None, None, None, :]
+        gx2 = (gb[..., 0] + gb[..., 2] / 2)[:, None, None, None, :]
+        gy2 = (gb[..., 1] + gb[..., 3] / 2)[:, None, None, None, :]
+        iw = jnp.maximum(jnp.minimum(px2[..., None], gx2)
+                         - jnp.maximum(px1[..., None], gx1), 0.0)
+        ih = jnp.maximum(jnp.minimum(py2[..., None], gy2)
+                         - jnp.maximum(py1[..., None], gy1), 0.0)
+        inter_a = iw * ih
+        union_a = (pw_ * ph_)[..., None] + (
+            gb[..., 2] * gb[..., 3])[:, None, None, None, :] - inter_a
+        best_iou = jnp.max(
+            jnp.where(valid[:, None, None, None, :],
+                      inter_a / jnp.maximum(union_a, 1e-9), 0.0), axis=-1)
+        obj_w = jnp.where((best_iou > ignore_thresh) & (obj_t < 0.5),
+                          0.0, 1.0)
+        bce = jax.nn.softplus(obj_logit) - obj_t * obj_logit
+        loss_obj = jnp.sum((bce * obj_w).reshape(n, -1), axis=1)
+
+        # class loss at responsible cells
+        cls_at = cls_logit[bidx, a_local, :, cj, ci]    # [n, B, C]
+        smooth = (1.0 / class_num if use_label_smooth else 0.0)
+        onehot = jax.nn.one_hot(gl, class_num) * (1 - smooth) + \
+            smooth / class_num
+        bce_c = jax.nn.softplus(cls_at) - onehot * cls_at
+        loss_cls = jnp.sum(jnp.sum(bce_c, axis=-1)
+                           * resp.astype(jnp.float32), axis=1)
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return apply_op("yolo_loss", fwd, (x, gt_box, gt_label), {})
+
+
+__all__ += ["RoIAlign", "RoIPool", "PSRoIPool", "psroi_pool", "read_file",
+            "decode_jpeg", "yolo_box", "yolo_loss"]

@@ -264,3 +264,121 @@ class RandomRotation(BaseTransform):
         a = np.asarray(img)
         angle = random.uniform(*self.degrees)
         return ndi.rotate(a, angle, reshape=False, order=1, mode="nearest")
+
+
+from . import functional  # noqa: E402,F401
+from .functional import (  # noqa: E402,F401
+    to_tensor,
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    normalize,
+    pad,
+    perspective,
+    resize,
+    rotate,
+    to_grayscale,
+    vflip,
+)
+
+
+class HueTransform(BaseTransform):
+    """reference HueTransform: random hue in [-value, value]."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return np.asarray(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class RandomAffine(BaseTransform):
+    """reference RandomAffine: random rotation/translate/scale/shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = ((-degrees, degrees)
+                        if isinstance(degrees, numbers.Number) else degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = random.uniform(*self.shear) if self.shear else 0.0
+        return affine(a, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomErasing(BaseTransform):
+    """reference RandomErasing (Cutout-style regularization)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if random.random() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                return erase(a, i, j, eh, ew, self.value)
+        return a
+
+
+class RandomPerspective(BaseTransform):
+    """reference RandomPerspective: random 4-corner perspective warp."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        if random.random() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[random.randint(0, dx), random.randint(0, dy)],
+               [w - 1 - random.randint(0, dx), random.randint(0, dy)],
+               [w - 1 - random.randint(0, dx), h - 1 - random.randint(0, dy)],
+               [random.randint(0, dx), h - 1 - random.randint(0, dy)]]
+        return perspective(a, start, end, interpolation=self.interpolation,
+                           fill=self.fill)

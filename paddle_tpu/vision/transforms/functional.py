@@ -1,0 +1,252 @@
+"""Functional image transforms (reference
+``python/paddle/vision/transforms/functional*.py``).
+
+Host-side numpy on HWC arrays (PIL images are converted) — the same
+execution model as the reference's cv2/PIL backends: transforms are data
+preparation that runs in DataLoader workers, never on the accelerator.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "to_tensor", "to_grayscale", "hflip", "vflip", "normalize", "pad",
+    "resize", "crop", "center_crop", "adjust_brightness", "adjust_contrast",
+    "adjust_hue", "rotate", "affine", "perspective", "erase",
+]
+
+
+def _np_img(img):
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+def to_tensor(pic, data_format="CHW"):
+    from ...framework.tensor import Tensor
+
+    a = _np_img(pic).astype(np.float32)
+    if a.dtype == np.uint8 or a.max() > 1.5:
+        a = a / 255.0
+    if data_format == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return Tensor(a)
+
+
+def to_grayscale(img, num_output_channels=1):
+    a = _np_img(img).astype(np.float32)
+    if a.shape[-1] >= 3:
+        g = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+    else:
+        g = a[..., 0]
+    g = g[:, :, None]
+    return np.repeat(g, num_output_channels, axis=-1) \
+        if num_output_channels > 1 else g
+
+
+def hflip(img):
+    return _np_img(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _np_img(img)[::-1].copy()
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (a - mean[:, None, None]) / std[:, None, None]
+    return (a - mean) / std
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _np_img(img)
+    if isinstance(padding, numbers.Number):
+        p = [padding] * 4
+    elif len(padding) == 2:
+        p = [padding[0], padding[1], padding[0], padding[1]]
+    else:
+        p = list(padding)
+    widths = ((p[1], p[3]), (p[0], p[2])) + ((0, 0),) * (a.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(a, widths, mode=mode, **kw)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import _resize_np
+
+    return _resize_np(_np_img(img), size)
+
+
+def crop(img, top, left, height, width):
+    return _np_img(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    a = _np_img(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    th, tw = output_size
+    h, w = a.shape[:2]
+    return crop(a, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def adjust_brightness(img, brightness_factor):
+    a = _np_img(img).astype(np.float32)
+    out = a * brightness_factor
+    return np.clip(out, 0, 255 if a.max() > 1.5 else 1.0).astype(
+        np.asarray(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    a = _np_img(img).astype(np.float32)
+    mean = to_grayscale(a).mean()
+    out = mean + contrast_factor * (a - mean)
+    return np.clip(out, 0, 255 if a.max() > 1.5 else 1.0).astype(
+        np.asarray(img).dtype)
+
+
+def _rgb_to_hsv(a):
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    mx, mn = a.max(-1), a.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff)[m] % 6
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = h / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return h, s, mx
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(int) % 6
+    out = np.zeros(h.shape + (3,), np.float32)
+    conds = [(i == 0, (v, t, p)), (i == 1, (q, v, p)), (i == 2, (p, v, t)),
+             (i == 3, (p, q, v)), (i == 4, (t, p, v)), (i == 5, (v, p, q))]
+    for cond, (rr, gg, bb) in conds:
+        out[..., 0][cond] = rr[cond]
+        out[..., 1][cond] = gg[cond]
+        out[..., 2][cond] = bb[cond]
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    a = _np_img(img).astype(np.float32)
+    scale = 255.0 if a.max() > 1.5 else 1.0
+    h, s, v = _rgb_to_hsv(a / scale)
+    h = (h + hue_factor) % 1.0
+    out = _hsv_to_rgb(h, s, v) * scale
+    return out.astype(np.asarray(img).dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    import scipy.ndimage as ndi
+
+    a = _np_img(img)
+    return ndi.rotate(a, angle, reshape=bool(expand),
+                      order=0 if interpolation == "nearest" else 1,
+                      mode="constant", cval=fill)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """2-D affine (reference transforms.functional.affine): rotation +
+    translation + scale + shear about the image center."""
+    import scipy.ndimage as ndi
+
+    a = _np_img(img)
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    ang = np.deg2rad(angle)
+    if isinstance(shear, numbers.Number):
+        shear = (shear, 0.0)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix (y, x) convention
+    rot = np.array([[np.cos(ang), -np.sin(ang)],
+                    [np.sin(ang), np.cos(ang)]])
+    shr = np.array([[1.0, np.tan(sy)], [np.tan(sx), 1.0]])
+    m = rot @ shr * scale
+    minv = np.linalg.inv(m)
+    offset = np.array([cy, cx]) - minv @ (
+        np.array([cy, cx]) + np.array([translate[1], translate[0]]))
+    order = 0 if interpolation == "nearest" else 1
+    out = np.stack([
+        ndi.affine_transform(a[..., c], minv, offset=offset, order=order,
+                             mode="constant", cval=fill)
+        for c in range(a.shape[-1])], axis=-1)
+    return out
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective warp mapping ``startpoints`` -> ``endpoints`` (reference
+    transforms.functional.perspective); homography solved from the 4 point
+    pairs, applied by inverse mapping."""
+    import scipy.ndimage as ndi
+
+    a = _np_img(img)
+    # solve h such that endpoints = H(startpoints); we need the INVERSE map
+    src = np.asarray(endpoints, np.float64)
+    dst = np.asarray(startpoints, np.float64)
+    A = []
+    for (x, y), (u, v) in zip(src, dst):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    b = dst.reshape(-1)
+    hvec = np.linalg.lstsq(np.asarray(A), b, rcond=None)[0]
+    H = np.append(hvec, 1.0).reshape(3, 3)
+    hgt, wid = a.shape[:2]
+    ys, xs = np.meshgrid(np.arange(hgt), np.arange(wid), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1).astype(np.float64)
+    mapped = H @ coords
+    mx = (mapped[0] / mapped[2]).reshape(hgt, wid)
+    my = (mapped[1] / mapped[2]).reshape(hgt, wid)
+    # snap fp solver noise: a -1e-15 coordinate would otherwise fall
+    # "outside" the image and read the constant fill
+    mx = np.where(np.abs(mx - np.round(mx)) < 1e-6, np.round(mx), mx)
+    my = np.where(np.abs(my - np.round(my)) < 1e-6, np.round(my), my)
+    order = 0 if interpolation == "nearest" else 1
+    out = np.stack([
+        ndi.map_coordinates(a[..., c], [my, mx], order=order,
+                            mode="constant", cval=fill)
+        for c in range(a.shape[-1])], axis=-1)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Erase region [i:i+h, j:j+w] with value ``v`` (reference
+    transforms.functional.erase). Accepts HWC numpy or CHW Tensor."""
+    from ...framework.tensor import Tensor
+
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        arr = img._value
+        val = jnp.broadcast_to(jnp.asarray(v, arr.dtype),
+                               arr[..., i:i + h, j:j + w].shape)
+        out = arr.at[..., i:i + h, j:j + w].set(val)
+        return Tensor(out)
+    a = _np_img(img).copy()
+    a[i:i + h, j:j + w] = v
+    return a

@@ -43,8 +43,11 @@ try:
     # (prefer-no-scatter/-gather), and a key collision SIGABRTs mid-suite
     # when an AOT executable from the other machine type loads
     _model_line = next((l for l in _lines if l.startswith("model name")), "")
+    # the visible core count sways XLA:CPU target tuning (prefer-no-scatter
+    # et al.) even on identical silicon — key on it too
     _cpu_key = hashlib.sha1(
-        (_flags_line + _model_line).encode()).hexdigest()[:12]
+        (_flags_line + _model_line + f"n{os.cpu_count()}").encode()
+    ).hexdigest()[:12]
 except OSError:
     _cpu_key = "generic"
 jax.config.update("jax_compilation_cache_dir", f"/tmp/jax_pt_cache_{_cpu_key}")
