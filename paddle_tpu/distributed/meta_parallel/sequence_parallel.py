@@ -41,12 +41,19 @@ __all__ = ["ring_attention", "split_sequence", "gather_sequence"]
 _NEG_INF = -1e30
 
 
-def _chunk_attend(q, k, v, o, m, l, scale, mask_mode, q_idx, kv_idx, s_local):
+def _chunk_attend(q, k, v, o, m, l, scale, mask_mode, q_idx, kv_idx, s_local,
+                  dropout_p=0.0, dropout_key=None):
     """One online-softmax update of the running (o, m, l) with a KV chunk.
 
     q: [b, sq, h, d]; k/v: [b, sk, h, d]; o: [b, sq, h, d] f32;
     m, l: [b, h, sq] f32. mask_mode: 0 full, 1 causal-diagonal, 2 skip —
     traced scalars resolved with jnp.where (uniform SPMD compute).
+
+    Dropout (post-softmax, like the fused kernels): the keep mask is drawn
+    from ``dropout_key`` folded by the GLOBAL (q_chunk, kv_chunk) pair, so
+    every device draws the mask its chunk pair owns and the autodiff
+    backward (which replays this trace) reuses the identical bits; l
+    accumulates the UNdropped p, only the value product sees the mask.
     """
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
@@ -66,19 +73,24 @@ def _chunk_attend(q, k, v, o, m, l, scale, mask_mode, q_idx, kv_idx, s_local):
     p = jnp.where(keep[None, None], p, 0.0)
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + jnp.sum(p, axis=-1)
+    if dropout_p > 0.0:
+        ck = jax.random.fold_in(dropout_key, q_idx * 65536 + kv_idx)
+        drop_keep = jax.random.bernoulli(ck, 1.0 - dropout_p, p.shape)
+        p = jnp.where(drop_keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
     o_new = o * jnp.swapaxes(alpha, 1, 2)[..., None] + pv
     return o_new, m_new, l_new
 
 
-def _ring_attention_impl(q, k, v, mesh, causal, scale, axis=AXIS_SEP):
+def _ring_attention_impl(q, k, v, mesh, causal, scale, axis=AXIS_SEP,
+                         dropout_p=0.0, dropout_key=None):
     """Global [b, S, h, d] arrays; runs the rotation ring manual over sep."""
     ax = mesh.axis_names.index(axis)
     n = mesh.devices.shape[ax]
     if n == 1:
         # degenerate ring: plain blockwise attention
-        return _single_chunk(q, k, v, causal, scale)
+        return _single_chunk(q, k, v, causal, scale, dropout_p, dropout_key)
 
     # nested manual regions (e.g. ring attention inside the pp-manual
     # pipeline stage body): shard_map must receive the AMBIENT abstract mesh
@@ -114,7 +126,8 @@ def _ring_attention_impl(q, k, v, mesh, causal, scale, axis=AXIS_SEP):
             else:
                 mask_mode = jnp.zeros((), jnp.int32)
             return _chunk_attend(q_l, k_c, v_c, o, m, l, scale,
-                                 mask_mode, i, kv_idx, s_local)
+                                 mask_mode, i, kv_idx, s_local,
+                                 dropout_p, dropout_key)
 
         # own chunk first (no rotation), then n-1 permute-then-attend steps:
         # exactly n-1 KV rotations total
@@ -145,7 +158,7 @@ def _ring_attention_impl(q, k, v, mesh, causal, scale, axis=AXIS_SEP):
     )(q, k, v)
 
 
-def _single_chunk(q, k, v, causal, scale):
+def _single_chunk(q, k, v, causal, scale, dropout_p=0.0, dropout_key=None):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -153,11 +166,15 @@ def _single_chunk(q, k, v, causal, scale):
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(cmask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_p > 0.0:
+        keep = jax.random.bernoulli(jax.random.fold_in(dropout_key, 0),
+                                    1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(q.dtype)
 
 
 def ring_attention(query, key, value, is_causal=True, scale=None, mesh=None,
-                   axis=AXIS_SEP, name=None):
+                   axis=AXIS_SEP, dropout_p=0.0, name=None):
     """Sequence-parallel attention over the ``sep`` mesh axis.
 
     Args:
@@ -177,12 +194,22 @@ def ring_attention(query, key, value, is_causal=True, scale=None, mesh=None,
         mesh = hcg.mesh
     if scale is None:
         scale = 1.0 / math.sqrt(query.shape[-1])
+    if not 0.0 <= dropout_p < 1.0:
+        raise ValueError(
+            f"dropout_p must be in [0, 1), got {dropout_p}")
+    dkey = None
+    if dropout_p > 0.0:
+        from ...framework import random as rnd
 
-    def fwd(q, k, v):
+        dkey = rnd.next_key()
+
+    def fwd(q, k, v, dk=None):
         return _ring_attention_impl(q, k, v, mesh, bool(is_causal),
-                                    float(scale), axis)
+                                    float(scale), axis,
+                                    float(dropout_p), dk)
 
-    return apply_op("ring_attention", fwd, (query, key, value), {})
+    args = (query, key, value) if dkey is None else (query, key, value, dkey)
+    return apply_op("ring_attention", fwd, args, {})
 
 
 def split_sequence(x, mesh=None, axis_name=AXIS_SEP, seq_axis=1):

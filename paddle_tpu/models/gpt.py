@@ -144,12 +144,6 @@ class GPTDecoderLayer(Layer):
         # (distributed/meta_parallel/sequence_parallel.py — green-field,
         # SURVEY §5; the reference has no SP/CP path)
         self._use_sep = cfg.use_sep and _sep_degree() > 1
-        if self._use_sep and cfg.attention_dropout > 0:
-            raise ValueError(
-                "use_sep with attention_dropout>0 is not supported: the ring "
-                "schedule has no per-chunk dropout path yet — set "
-                "attention_dropout=0 (hidden_dropout is fine)"
-            )
 
     def forward(self, x, attn_mask=None, cache=None):
         b, s, h = x.shape
@@ -168,7 +162,9 @@ class GPTDecoderLayer(Layer):
         if self._use_sep and cache is None and attn_mask is None:
             from ..distributed.meta_parallel import ring_attention
 
-            attn = ring_attention(q, k, v, is_causal=True)
+            attn = ring_attention(
+                q, k, v, is_causal=True,
+                dropout_p=self.attn_dropout if self.training else 0.0)
         else:
             attn = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,
