@@ -71,6 +71,12 @@ class ElasticManager:
         if self._store is None:
             os.makedirs(self.elastic_dir, exist_ok=True)
         self._hb_path = os.path.join(self.elastic_dir, f"rank{self.rank}.json")
+        # staleness is judged by when the WATCHER last saw a peer's payload
+        # change, never by the producer's embedded clock: across nodes the
+        # store backend has no shared clock, and skew > timeout would
+        # otherwise yield false RESTART verdicts.
+        # rank -> ((producer_ts, status) change marker, watcher local_ts)
+        self._last_change = {}
 
     # -- registration / heartbeat (≙ etcd keepalive) -------------------------
     def register(self):
@@ -136,10 +142,16 @@ class ElasticManager:
         statuses = [p.get("status") for p in peers.values()]
         if all(s == ElasticStatus.COMPLETED for s in statuses):
             return ElasticStatus.COMPLETED
-        for p in peers.values():
+        for r, p in peers.items():
             if p.get("status") == ElasticStatus.ERROR:
                 return ElasticStatus.RESTART
-            if (p.get("status") == "running"
-                    and now - float(p.get("ts", 0)) > self.timeout):
+            if p.get("status") != "running":
+                continue
+            # producer ts is an opaque change marker, not a clock to compare
+            marker = (p.get("ts"), p.get("status"))
+            prev = self._last_change.get(r)
+            if prev is None or prev[0] != marker:
+                self._last_change[r] = (marker, now)
+            elif now - prev[1] > self.timeout:
                 return ElasticStatus.RESTART
         return None

@@ -107,7 +107,9 @@ def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
             order[v] = len(order)
     out_nodes = np.fromiter(order.keys(), dtype=xs.dtype)
     src = np.array([order[int(v)] for v in nb], dtype=np.int64)
-    dst = np.repeat(np.arange(len(xs), dtype=np.int64), ct)
+    # dst ids come from the order[] map, not arange: duplicate centers in x
+    # collapse into one first-seen slot, so positional ids would drift.
+    dst = np.repeat(np.array([order[int(v)] for v in xs], dtype=np.int64), ct)
     return Tensor(jnp.asarray(src)), Tensor(jnp.asarray(dst)), \
         Tensor(jnp.asarray(out_nodes))
 
@@ -197,9 +199,16 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
 
 def identity_loss(x, reduction="none"):
     """reference ``incubate/identity_loss``: mark a value as the loss
-    (IPU-era marker); reduces per ``reduction``."""
+    (IPU-era marker); reduces per ``reduction``.
+
+    Integer codes follow the reference contract (``fluid/layers/loss.py``
+    identity_loss): 0 = 'sum', 1 = 'mean', 2 = 'none'."""
     if reduction in ("none", 2):
         return x
-    if reduction in ("sum", 1):
+    if reduction in ("sum", 0):
         return x.sum()
-    return x.mean()
+    if reduction in ("mean", 1):
+        return x.mean()
+    raise ValueError(
+        f"identity_loss reduction must be 'sum'/0, 'mean'/1 or 'none'/2, "
+        f"got {reduction!r}")

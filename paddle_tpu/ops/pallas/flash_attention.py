@@ -72,6 +72,15 @@ def _causal_run(qi, ki, block_q, block_k, offset):
     return qi * block_q + block_q - 1 + offset >= ki * block_k
 
 
+def _zero_masked_rows(p, stat):
+    """Zero softmax rows whose running max (fwd) or saved lse (bwd) is
+    still NEG_INF: a fully-masked causal query row (the sq > sk boundary
+    landing inside a tile) has every logit at NEG_INF, so ``exp(s - stat)``
+    collapses to exp(≈0) = 1 — a spurious uniform softmax. The contract
+    for such rows is output 0 / lse NEG_INF / zero gradients."""
+    return jnp.where(stat > NEG_INF * 0.5, p, 0.0)
+
+
 def _dropout_mask(seed_ref, qi, ki, shape, dropout_p, head=None):
     """Regenerate the per-tile keep mask from the hardware PRNG. The tile
     coordinates are folded into the two user seed words (``prng_seed``
@@ -136,7 +145,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         m_prev = m_ref[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        p = _zero_masked_rows(jnp.exp(s - m_new), m_new)
         # l accumulates the UNdropped p (softmax normalizes pre-dropout);
         # only the value matmul sees the dropped probabilities.
         l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -177,7 +186,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
     def _body():
         s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
                     block_k, offset)
-        p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+        lse = lse_ref[0, 0][:, 0:1]
+        p = _zero_masked_rows(jnp.exp(s - lse), lse)
         do = do_ref[0, 0]
         # delta = rowsum(do * o): recomputed per tile from the streamed o
         # block — elementwise O(block_q*d), far cheaper than materializing a
@@ -222,7 +232,8 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
     def _body():
         s = _logits(q_ref, k_ref, b_ref, qi, ki, scale, causal, block_q,
                     block_k, offset)
-        p = jnp.exp(s - lse_ref[0, 0][:, 0:1])
+        lse = lse_ref[0, 0][:, 0:1]
+        p = _zero_masked_rows(jnp.exp(s - lse), lse)
         do = do_ref[0, 0]
         delta = jnp.sum(
             do.astype(jnp.float32) * o_ref[0, 0].astype(jnp.float32),

@@ -54,6 +54,7 @@ from .flash_attention import (
     _inject_none,
     _keep_bits,
     _pick_block,
+    _zero_masked_rows,
 )
 
 DEFAULT_BLOCK_Q = 1024
@@ -136,12 +137,8 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
         for j in range(hpg):
             s = _head_logits(q_ref, k_ref, add, j, d, scale)
             m = jnp.max(s, axis=-1, keepdims=True)
-            p = jnp.exp(s - m)
-            # a fully-masked q row (causal with sq > sk) has m == NEG_INF
-            # and would see p = exp(0) = 1 everywhere; zero it so the
-            # output is 0 and lse stays NEG_INF (matching the multi-tile
-            # path's @pl.when(run) skip)
-            p = jnp.where(m > NEG_INF * 0.5, p, 0.0)
+            # fully-masked q rows (causal sq > sk): output 0, lse NEG_INF
+            p = _zero_masked_rows(jnp.exp(s - m), m)
             l = jnp.sum(p, axis=-1, keepdims=True)
             l_safe = jnp.where(l == 0.0, 1.0, l)
             if dropout_p > 0.0:
@@ -173,7 +170,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
             m_prev = m_ref[j][:, 0:1]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
             alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new)
+            # rows fully masked SO FAR keep l = 0 so _finish emits
+            # output 0 / lse NEG_INF (same contract as the single path)
+            p = _zero_masked_rows(jnp.exp(s - m_new), m_new)
             l_new = l_ref[j][:, 0:1] * alpha + jnp.sum(p, axis=-1,
                                                        keepdims=True)
             if dropout_p > 0.0:
@@ -239,7 +238,9 @@ def _bwd_fused_kernel(seed_ref, q_ref, k_ref, v_ref, b_ref, do_ref, o_ref,
         add = _tile_bias(b_ref, qi, ki, block_q, block_k, offset, causal)
         for j in range(hpg):
             s = _head_logits(q_ref, k_ref, add, j, d, scale)
-            p = jnp.exp(s - lse_ref[0, j][:, 0:1])
+            lse_j = lse_ref[0, j][:, 0:1]
+            # fully-masked rows saved lse == NEG_INF: zero gradients
+            p = _zero_masked_rows(jnp.exp(s - lse_j), lse_j)
             doh = do_ref[0, :, j * d:(j + 1) * d]
             oh = o_ref[0, :, j * d:(j + 1) * d]
             delta = jnp.sum(

@@ -220,11 +220,24 @@ def test_incubate_segment_and_graph_ops():
     assert cnt.numpy().tolist() == [2, 0]
     assert sorted(neigh.numpy().tolist()) == [1, 2]
 
+    # advisor regression: duplicate centers must map dst through the
+    # first-seen order table, not positional arange
+    src2, dst2, nodes2 = inc.graph_reindex(
+        t(np.array([5, 5, 9])), t(np.array([9, 7, 5, 3])),
+        t(np.array([1, 1, 2])))
+    assert nodes2.numpy().tolist() == [5, 9, 7, 3]
+    assert dst2.numpy().tolist() == [0, 0, 1, 1]
+
     sm = inc.softmax_mask_fuse_upper_triangle(
         t(np.zeros((1, 1, 4, 4), np.float32)))
     np.testing.assert_allclose(sm.numpy()[0, 0, 0], [1, 0, 0, 0])
     assert float(inc.identity_loss(t(np.array([2.0, 4.0])),
                                    "mean").numpy()) == 3.0
+    # advisor regression: integer reduction codes are 0=sum, 1=mean, 2=none
+    assert float(inc.identity_loss(t(np.array([2.0, 4.0])), 0).numpy()) == 6.0
+    assert float(inc.identity_loss(t(np.array([2.0, 4.0])), 1).numpy()) == 3.0
+    assert inc.identity_loss(t(np.array([2.0, 4.0])),
+                             2).numpy().tolist() == [2.0, 4.0]
 
 
 # -- fleet role makers / misc ------------------------------------------------

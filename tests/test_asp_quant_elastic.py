@@ -139,7 +139,34 @@ def test_elastic_stale_peer(tmp_path):
     d = str(tmp_path / "el2")
     m0 = ElasticManager(elastic_dir=d, rank=0, world_size=2, timeout=0.2)
     m0.register()
-    # fake a stale peer heartbeat
+    # a peer whose payload never changes again goes stale after `timeout`
+    # of WATCHER-observed silence — the producer ts is an opaque change
+    # marker, so cross-node clock skew cannot trigger false restarts
     with open(os.path.join(d, "rank1.json"), "w") as f:
-        json.dump({"rank": 1, "ts": time.time() - 10, "status": "running"}, f)
+        json.dump({"rank": 1, "ts": 123.0, "status": "running"}, f)
+    assert m0.watch() is None          # first sighting just records it
+    time.sleep(0.3)
+    m0.heartbeat()                     # self stays fresh
     assert m0.watch() == ElasticStatus.RESTART
+
+
+def test_elastic_skewed_but_alive_peer(tmp_path):
+    """A peer with a wildly skewed clock that keeps heartbeating must NOT
+    be flagged: staleness is watcher-observed payload-change age."""
+    import json
+    import os
+    import time
+
+    from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+
+    d = str(tmp_path / "el3")
+    m0 = ElasticManager(elastic_dir=d, rank=0, world_size=2, timeout=0.2)
+    m0.register()
+    for tick in range(4):
+        # producer clock is an hour behind and drifting — payload changes
+        with open(os.path.join(d, "rank1.json"), "w") as f:
+            json.dump({"rank": 1, "ts": time.time() - 3600.0 + tick,
+                       "status": "running"}, f)
+        m0.heartbeat()
+        assert m0.watch() is None
+        time.sleep(0.1)

@@ -129,6 +129,69 @@ def test_router_prefers_packed(monkeypatch):
     assert called.get("hit")
 
 
+def _ref_rect(q, k, v, h, causal):
+    """Einsum reference for sq != sk (bottom-right-aligned causal)."""
+    b, sq, e = q.shape
+    sk = k.shape[1]
+    d = e // h
+    qh = q.reshape(b, sq, h, d)
+    kh = k.reshape(b, sk, h, d)
+    vh = v.reshape(b, sk, h, d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) / np.sqrt(d)
+    if causal:
+        m = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(m, logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        # fully-masked rows: softmax of all -1e30 is uniform garbage; the
+        # kernel contract is output 0 for those rows
+        p = jnp.where(m.any(-1)[None, None, :, None], p, 0.0)
+    else:
+        p = jax.nn.softmax(logits, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, vh).reshape(b, sq, e)
+
+
+def test_multi_tile_causal_boundary_inside_tile():
+    """Advisor regression: sq > sk causal where the masked-row boundary sits
+    INSIDE a q tile (offset=-128, block_q=256) — the multi-tile forward must
+    zero fully-masked rows, not emit a spurious uniform softmax."""
+    ks = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(ks[0], (1, 512, 2 * 64), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 384, 2 * 64), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 384, 2 * 64), jnp.float32)
+    out = flash_attention_packed(q, k, v, 2, causal=True, block_q=256,
+                                 block_k=128, interpret=True)
+    # offset = -128: rows 0..127 attend nothing (inside tile qi=0)
+    np.testing.assert_array_equal(np.asarray(out[0, :128]), 0.0)
+    want = _ref_rect(q, k, v, 2, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5)
+
+
+def test_multi_tile_causal_boundary_grads_zero():
+    """Advisor regression: the fused backward must give zero dq for
+    fully-masked rows and zero spurious dk/dv from them."""
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (1, 512, 2 * 64), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 384, 2 * 64), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 384, 2 * 64), jnp.float32)
+    co = jax.random.normal(jax.random.key(8), q.shape, jnp.float32)
+
+    def f_packed(q, k, v):
+        out = flash_attention_packed(q, k, v, 2, causal=True, block_q=256,
+                                     block_k=128, bwd_block=256,
+                                     interpret=True)
+        return jnp.vdot(out, co)
+
+    def f_ref(q, k, v):
+        return jnp.vdot(_ref_rect(q, k, v, 2, causal=True), co)
+
+    gp = jax.grad(f_packed, (0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(gp[0][0, :128]), 0.0)
+    for name, a, b in zip("qkv", gp, gr):
+        err = float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+        assert err < 1e-4, (name, err)
+
+
 def test_single_tile_causal_fully_masked_rows():
     """Review regression: sq > sk causal with one k tile — query rows with
     no visible keys must output 0 (not the mean of v)."""

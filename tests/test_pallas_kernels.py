@@ -123,6 +123,46 @@ def test_flash_causal_cross_length():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+def test_flash_causal_fully_masked_rows():
+    """Advisor regression (layout-swapping kernel): causal sq > sk with the
+    masked-row boundary inside a q tile (offset=-128, block_q=256) — fully
+    masked rows must emit output 0 and zero gradients, not a uniform
+    softmax over v."""
+    rng = np.random.RandomState(11)
+    b, h, d = 1, 2, 64
+    q = jnp.asarray(rng.randn(b, 512, h, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, 384, h, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, 384, h, d).astype(np.float32)) * 0.3
+
+    def masked_ref(q, k, v):
+        out = _ref_attention(q, k, v, causal=True)
+        sq, sk = q.shape[1], k.shape[1]
+        vis = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq).any(-1)
+        return jnp.where(vis[None, :, None, None], out, 0.0)
+
+    def loss(fn):
+        def f(q, k, v):
+            out = fn(q, k, v)
+            return jnp.sum(out**2), out
+        return f
+
+    with pallas.interpret_mode():
+        (val, out), gf = jax.value_and_grad(
+            loss(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=256, block_k=128)),
+            argnums=(0, 1, 2), has_aux=True,
+        )(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out[:, :128]), 0.0)
+    np.testing.assert_array_equal(np.asarray(gf[0][:, :128]), 0.0)
+    (_, ref), gr = jax.value_and_grad(loss(masked_ref), argnums=(0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    for a, bb in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                   atol=5e-5, rtol=5e-4)
+
+
 def test_sdpa_broadcast_padding_mask_routes_to_einsum():
     """(b,1,1,sk) key-padding masks can't stream through the flash kernel;
     routing must fall back to the broadcasting einsum path, not crash."""
