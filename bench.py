@@ -3,6 +3,10 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no in-tree numbers (BASELINE.md) — vs_baseline
 compares against the previous round's BENCH_r*.json when present, else 1.0.
+
+Measurement protocol (warmup/donated-state chain/fence-on-last-loss) and
+the chip-peak table live in tools/bench_common.py, shared with the
+ResNet-50 and BERT-large benchmarks.
 """
 from __future__ import annotations
 
@@ -14,27 +18,17 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tools"))
+from bench_common import (  # noqa: E402
+    device_peak,
+    measure_steps,
+    retry,
+)
+
 
 def main():
-    """Retry wrapper: the remote-compile tunnel to the TPU terminal can drop
-    mid-run (round 1 lost its number to exactly that); transient infra
-    failures get 3 attempts before the benchmark reports failure."""
-    last = None
-    for attempt in range(3):
-        if attempt:
-            time.sleep(5.0 * attempt)
-        try:
-            return _run()
-        except Exception as e:  # noqa: BLE001 - retry any runtime failure
-            last = e
-            print(f"bench attempt {attempt + 1} failed: {e!r}", file=sys.stderr)
-            try:
-                import jax
-
-                jax.clear_caches()
-            except Exception:
-                pass
-    raise last
+    retry(_run)
 
 
 def _run():
@@ -44,7 +38,6 @@ def _run():
     on_tpu = backend not in ("cpu",)
 
     import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
     from paddle_tpu.framework.tensor import Tensor
     from paddle_tpu.jit.functionalize import CompiledStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
@@ -94,34 +87,12 @@ def _run():
     # results across processes keyed on (executable, inputs), so repeated
     # fixed-seed runs would replay cached results and inflate the number
     rng = np.random.RandomState(time.time_ns() % (2**31))
-    batches = [
-        Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
-        for _ in range(3 + iters)
-    ]
+    batches = []
+    for _ in range(3 + iters):
+        t = Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+        batches.append((t, t))
 
-    # warmup (compile)
-    for i in range(3):
-        loss = step(batches[i], batches[i])
-        np.asarray(loss._value)
-
-    # Steady-state measurement: issue all steps back-to-back, then fetch
-    # every loss.  Each step's donated state feeds the next (a data-dependence
-    # chain), so the remote layer's (executable, inputs) result cache can
-    # never replay a step, and fetching all losses at the end forces full
-    # execution of the chain.  This amortizes the ~87 ms relay round-trip
-    # (measured by tools/latency_probe.py) instead of paying it per step —
-    # per-step synchronous loss fetches are not part of real training.
-    # Fence on the LAST loss only: every host fetch through the relay costs a
-    # full round trip, and the donated-state chain already makes the last
-    # step's output depend on every prior step.  The remaining losses are
-    # fetched after the timer for the finiteness check.
-    t0 = time.perf_counter()
-    losses = [step(batches[3 + i], batches[3 + i]) for i in range(iters)]
-    last = float(np.asarray(losses[-1]._value))
-    total = time.perf_counter() - t0
-    vals = [float(np.asarray(l._value)) for l in losses]
-    assert all(np.isfinite(v) for v in vals), f"bench losses not finite: {vals}"
-
+    total, _ = measure_steps(step, batches, iters)
     tokens_per_sec = batch * seq * iters / total
 
     # Achieved MFU: standard 6*N_matmul + 12*L*H*s flops/token convention
@@ -130,10 +101,7 @@ def _run():
     h_, l_, v_, s_ = cfg.hidden_size, cfg.num_layers, cfg.vocab_size, seq
     n_matmul = l_ * 12 * h_ * h_ + v_ * h_
     flops_per_token = 6 * n_matmul + 12 * l_ * h_ * s_
-    kind = jax.devices()[0].device_kind.lower()
-    peaks = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12, "v5p": 459e12,
-             "v6 lite": 918e12, "v6e": 918e12}
-    peak = next((p for k, p in peaks.items() if k in kind), None)
+    kind, peak = device_peak()
     # mfu only when the chip's bf16 peak is known — never a guessed peak
     mfu = tokens_per_sec * flops_per_token / peak if peak else None
 

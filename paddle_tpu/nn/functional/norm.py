@@ -78,22 +78,33 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 @op("batch_norm_infer")
 def _bn_infer_raw(x, rm, rv, weight, bias, epsilon=1e-5, axis=1):
+    # mixed-precision contract (same as the pallas layer_norm): statistics
+    # and the affine math run in fp32, the output returns in x.dtype — a
+    # bf16 conv stack with fp32 BN params stays bf16 end-to-end
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    scale = weight.reshape(shape) * jax_rsqrt(rv.reshape(shape) + epsilon)
-    return x * scale + (bias.reshape(shape) - rm.reshape(shape) * scale)
+    f32 = jnp.float32
+    scale = weight.astype(f32).reshape(shape) * jax_rsqrt(
+        rv.astype(f32).reshape(shape) + epsilon)
+    out = x.astype(f32) * scale + (
+        bias.astype(f32).reshape(shape) - rm.astype(f32).reshape(shape) * scale)
+    return out.astype(x.dtype)
 
 
 @op("batch_norm_train")
 def _bn_train_raw(x, weight, bias, epsilon=1e-5, axis=1):
     axes = tuple(i for i in range(x.ndim) if i != axis)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    f32 = jnp.float32
+    xf = x.astype(f32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    scale = weight.reshape(shape) * jax_rsqrt(var.reshape(shape) + epsilon)
-    out = x * scale + (bias.reshape(shape) - mean.reshape(shape) * scale)
-    return out, mean, var
+    scale = weight.astype(f32).reshape(shape) * jax_rsqrt(
+        var.reshape(shape) + epsilon)
+    out = xf * scale + (
+        bias.astype(f32).reshape(shape) - mean.reshape(shape) * scale)
+    return out.astype(x.dtype), mean, var
 
 
 def batch_norm(
@@ -122,22 +133,32 @@ def batch_norm(
     # update running stats (no grad flows; detached values)
     m = momentum
     n = x.size // x.shape[axis]
+    # _bn_train_raw returns fp32 stats; cast the update back so bf16
+    # running buffers keep their declared dtype across training steps
     unbiased = var._value * (n / max(n - 1, 1))
-    running_mean._value = running_mean._value * m + mean._value * (1 - m)
-    running_var._value = running_var._value * m + unbiased * (1 - m)
+    rm_dt = running_mean._value.dtype
+    rv_dt = running_var._value.dtype
+    running_mean._value = (running_mean._value * m
+                           + mean._value.astype(rm_dt) * (1 - m)).astype(rm_dt)
+    running_var._value = (running_var._value * m
+                          + unbiased.astype(rv_dt) * (1 - m)).astype(rv_dt)
     return out
 
 
 @op("instance_norm_op")
 def _instance_norm_raw(x, weight=None, bias=None, epsilon=1e-5, has_affine=False):
+    # fp32-internal like batch_norm: normalization in low precision loses
+    # the mean-subtraction cancellation; output returns in x.dtype
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    out = (x - mean) * jax_rsqrt(var + epsilon)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = (xf - mean) * jax_rsqrt(var + epsilon)
     if has_affine:
         shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
-        out = out * weight.reshape(shape) + bias.reshape(shape)
-    return out
+        out = (out * weight.astype(jnp.float32).reshape(shape)
+               + bias.astype(jnp.float32).reshape(shape))
+    return out.astype(x.dtype)
 
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
@@ -152,17 +173,18 @@ def _group_norm_raw(x, weight=None, bias=None, epsilon=1e-5, groups=1, has_affin
         x = jnp.moveaxis(x, -1, 1)
     n, c = x.shape[:2]
     spatial = x.shape[2:]
-    xg = x.reshape(n, groups, c // groups, *spatial)
+    xg = x.astype(jnp.float32).reshape(n, groups, c // groups, *spatial)
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
     var = jnp.var(xg, axis=axes, keepdims=True)
     out = ((xg - mean) * jax_rsqrt(var + epsilon)).reshape(n, c, *spatial)
     if has_affine:
         shape = [1, c] + [1] * len(spatial)
-        out = out * weight.reshape(shape) + bias.reshape(shape)
+        out = (out * weight.astype(jnp.float32).reshape(shape)
+               + bias.astype(jnp.float32).reshape(shape))
     if channel_last:
         out = jnp.moveaxis(out, 1, -1)
-    return out
+    return out.astype(x.dtype)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):

@@ -248,3 +248,39 @@ _op("F.batch_norm", (((2, 3, 4, 4), "f"), ((3,), "f2"), ((3,), "fp"),
 _op("F.cosine_similarity", (((3, 4), "fnz"), ((3, 4), "f2")), rtol=2e-2)
 _op("F.fold", (((1, 8, 4), "f"),),
     kwargs=dict(output_sizes=[4, 4], kernel_sizes=2, strides=2))
+
+
+# --- low-precision (bf16 / fp16) gradient axis ------------------------------
+# Mirrors the reference OpTest's per-dtype check_grad registrations
+# (``unittests/op_test.py:1851``: fp16/bf16 kernels are checked with
+# loosened per-dtype tolerances against an fp32 reference). Here every
+# table entry is additionally swept in bfloat16 AND float16
+# (tests/test_op_grad_sweep_lowp.py): the op runs end-to-end in the compute
+# dtype and its analytic gradient is compared, at low-precision-representable
+# input points, against the fp32 analytic gradient (itself validated against
+# finite differences by the main sweep).
+#
+# Defaults (relative to lowp eps: bf16 2^-8, fp16 2^-10):
+LOWP_DEFAULT = {
+    "bfloat16": dict(rtol=6e-2, atol=1e-2),
+    "float16": dict(rtol=2e-2, atol=4e-3),
+}
+# Entries below DEVIATE from the default — False skips the dtype with the
+# documented reason, a dict loosens tolerances for ops whose condition
+# number amplifies the representation error. Keyed by table api name
+# (duplicate entries share the key).
+LOWP = {
+    # XLA lowers these decompositions/solves through fp32-only routines on
+    # CPU/TPU; low-precision inputs would silently upcast, testing nothing
+    "ops.inverse": False,
+    "ops.det": False,
+    "ops.slogdet": False,
+    "ops.cholesky": False,
+    "ops.solve": False,
+    "ops.triangular_solve": False,
+    "ops.cholesky_solve": False,
+    "ops.pinv": False,
+    "ops.qr": False,
+    "ops.eigh": False,
+    "ops.matrix_power": False,
+}

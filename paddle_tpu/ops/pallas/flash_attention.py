@@ -503,7 +503,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, need_dbias,
             rows = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
             cols = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
             s = jnp.where((rows + offset >= cols)[None, None], s, NEG_INF)
-        p = jnp.exp(s - lse[..., 0:1])
+        p = _zero_masked_rows(jnp.exp(s - lse[..., 0:1]), lse[..., 0:1])
         dp = jnp.einsum("bhqd,bhkd->bhqk", g, v,
                         preferred_element_type=jnp.float32)
         delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), -1)

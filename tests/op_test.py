@@ -37,6 +37,45 @@ def numeric_grad(fn, inputs, idx, out_grad=None, delta=1e-3):
     return g
 
 
+def analytic_grads(fn, tensors):
+    """Forward + backward once; returns the list of input gradients (fp64
+    numpy). Gradient seed is ones in the output dtype."""
+    out = fn(*tensors)
+    out.backward(paddle.ones(out.shape, out.dtype))
+    return [np.asarray(t.grad._value, dtype=np.float64) for t in tensors], out
+
+
+def check_grad_lowp(fn, input_arrays, dtype="bfloat16", rtol=6e-2, atol=1e-2):
+    """Low-precision gradient check (reference ``unittests/op_test.py:1851``
+    per-dtype check_grad): run the op end-to-end in `dtype` and compare its
+    analytic gradient against the fp32 analytic gradient evaluated at the
+    SAME low-precision-representable input points. The fp32 analytic path is
+    itself validated against finite differences by the fp32 sweep, so this
+    chain checks exactly the low-precision computation error."""
+    import ml_dtypes
+
+    np_dt = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float16
+    snapped = [np.asarray(a, dtype=np_dt).astype(np.float32)
+               for a in input_arrays]
+    assert all(np.isfinite(s).all() for s in snapped), \
+        f"inputs not representable in {dtype}"
+
+    ref_ts = [paddle.to_tensor(a, stop_gradient=False) for a in snapped]
+    ref_grads, _ = analytic_grads(fn, ref_ts)
+
+    lp_ts = [paddle.to_tensor(np.asarray(a, dtype=np_dt), stop_gradient=False)
+             for a in snapped]
+    lp_grads, out = analytic_grads(fn, lp_ts)
+
+    for i, (lp, ref) in enumerate(zip(lp_grads, ref_grads)):
+        np.testing.assert_allclose(
+            lp, ref, rtol=rtol, atol=atol,
+            err_msg=(f"{dtype} gradient deviates from fp32 reference for "
+                     f"input {i} of {getattr(fn, '__name__', fn)}"),
+        )
+    return out
+
+
 def check_grad(fn, input_arrays, rtol=1e-2, atol=1e-3, delta=1e-3, out_grad=None):
     """Compare analytic backward() grads to finite differences for all inputs."""
     tensors = [paddle.to_tensor(a.astype(np.float32), stop_gradient=False) for a in input_arrays]

@@ -163,6 +163,45 @@ def test_flash_causal_fully_masked_rows():
                                    atol=5e-5, rtol=5e-4)
 
 
+def test_flash_causal_fully_masked_rows_dbias():
+    """Review regression: the trainable-bias backward must also zero
+    fully-masked causal rows — dbias on those rows is exactly 0 (the
+    forward output there is constant 0)."""
+    rng = np.random.RandomState(13)
+    b, h, d = 1, 2, 64
+    q = jnp.asarray(rng.randn(b, 256, h, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, 128, h, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, 128, h, d).astype(np.float32)) * 0.3
+    bias = jnp.asarray(rng.randn(256, 128).astype(np.float32)) * 0.1
+
+    def loss(bias):
+        with pallas.interpret_mode():
+            out = flash_attention(q, k, v, bias=bias, causal=True,
+                                  block_q=256, block_k=128, bias_grad=True)
+        return jnp.sum(out**2)
+
+    dbias = jax.grad(loss)(bias)
+    # offset = -128: rows 0..127 attend nothing
+    np.testing.assert_array_equal(np.asarray(dbias[:128]), 0.0)
+    assert np.abs(np.asarray(dbias[128:])).max() > 0
+
+
+def test_bn_running_stats_keep_declared_dtype():
+    """Review regression: bf16 running mean/var must not get silently
+    promoted to fp32 by the (fp32-internal) training-stat update."""
+    import paddle_tpu as paddle
+
+    bn = paddle.nn.BatchNorm2D(3)
+    bn.to(dtype="bfloat16")
+    bn.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 4, 4).astype(np.float32)
+    ).astype("bfloat16")
+    bn(x)
+    assert str(bn._mean.dtype).endswith("bfloat16"), bn._mean.dtype
+    assert str(bn._variance.dtype).endswith("bfloat16"), bn._variance.dtype
+
+
 def test_sdpa_broadcast_padding_mask_routes_to_einsum():
     """(b,1,1,sk) key-padding masks can't stream through the flash kernel;
     routing must fall back to the broadcasting einsum path, not crash."""

@@ -1,0 +1,88 @@
+"""Shared harness for the hardware model benchmarks (bench.py,
+tools/bench_resnet.py, tools/bench_bert.py).
+
+Measurement discipline (identical to bench.py, see its comments for the
+rationale): 3 warmup steps, then issue all measured steps back-to-back with
+donated state so each step's inputs depend on the previous step's outputs
+(the remote relay's (executable, inputs) result cache can never replay),
+fence on the LAST loss only, fetch the rest after the timer for the
+finiteness check.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# chip bf16 peak FLOP/s by device_kind substring; MFU is only reported when
+# the chip is known — never against a guessed peak
+PEAKS = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12, "v5p": 459e12,
+         "v6 lite": 918e12, "v6e": 918e12}
+
+
+def device_peak():
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    return kind, next((p for k, p in PEAKS.items() if k in kind), None)
+
+
+def retry(run, attempts=3):
+    """The remote-compile tunnel to the TPU terminal can drop mid-run;
+    transient infra failures get `attempts` tries before reporting failure."""
+    last = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5.0 * attempt)
+        try:
+            return run()
+        except Exception as e:  # noqa: BLE001 - retry any runtime failure
+            last = e
+            print(f"bench attempt {attempt + 1} failed: {e!r}", file=sys.stderr)
+            try:
+                import jax
+
+                jax.clear_caches()
+            except Exception:
+                pass
+    raise last
+
+
+def measure_steps(step, batches, iters, warmup=3):
+    """Run the warmup+steady-state protocol; returns (seconds, losses)."""
+    for i in range(warmup):
+        loss = step(*batches[i])
+        np.asarray(loss._value)
+    t0 = time.perf_counter()
+    losses = [step(*batches[warmup + i]) for i in range(iters)]
+    float(np.asarray(losses[-1]._value))  # fence on the dependence chain
+    total = time.perf_counter() - t0
+    vals = [float(np.asarray(l._value)) for l in losses]
+    assert all(np.isfinite(v) for v in vals), f"bench losses not finite: {vals}"
+    return total, vals
+
+
+def compiled_flops(step, batches):
+    """FLOPs of ONE compiled train step from XLA's own cost analysis
+    (includes remat recompute — i.e. this yields hardware-FLOPs utilization,
+    the honest number for 'how busy is the MXU')."""
+    try:
+        lowered = step.lower(*batches[0])
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception as e:  # pragma: no cover - cost analysis is best-effort
+        print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
+        return None
+
+
+def emit(result, artifact=None):
+    """Print the one-line JSON and optionally persist a repo-root artifact."""
+    print(json.dumps(result))
+    if artifact:
+        with open(artifact, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
