@@ -13,6 +13,8 @@ user surface (prepare/fit/evaluate/predict) driving one jitted SPMD step.
 from .process_mesh import ProcessMesh  # noqa: F401
 from .interface import shard_tensor, shard_op, reshard, dtensor_from_fn  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .planner import ChipSpec, Plan, Planner, plan_for  # noqa: F401
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "reshard",
-           "dtensor_from_fn", "Engine"]
+           "dtensor_from_fn", "Engine", "ChipSpec", "Plan", "Planner",
+           "plan_for"]

@@ -56,6 +56,73 @@ class Engine:
         self._train_step = None
         self._eval_step = None
         self._strategy_applied = False
+        # strategy=None with NO mesh given (neither argument nor ambient
+        # `with ProcessMesh(...)`) on a multi-device host means AUTO: the
+        # planner searches degrees + placements on the first batch
+        # (reference Planner semantics — engine.py:51 runs the planner when
+        # no dist_strategy is given). A user-provided mesh is authoritative
+        # and never overwritten.
+        self._n_avail = n_avail
+        self._auto_plan_pending = (strategy is None
+                                   and process_mesh is None
+                                   and get_current_process_mesh() is None
+                                   and n_avail > 1)
+        self.plan_ = None
+
+    # -- auto planning -------------------------------------------------------
+    def _auto_plan(self, x, y):
+        """Search (dp, mp, sharding) for this model on the available device
+        set and apply the winning plan: reshape the mesh to (dp, mp), shard
+        every >=2-D parameter per the plan's placements (GSPMD propagates),
+        and enable ZeRO via the strategy when the plan says so. pp is not
+        auto-applied (pipelining needs the fleet build path); dp and
+        sharding are searched exclusively because this applier realizes
+        ZeRO over the whole data axis. Falls back to the legacy replicated/
+        dp behavior when no factorization satisfies the model's
+        constraints."""
+        self._auto_plan_pending = False
+        from .planner import Planner, stats_from_forward
+
+        model, loss_fn = self.model, self._loss
+        n = self._n_avail  # respects the cluster device bound
+
+        def fwd_loss(xa, ya):
+            out = model(Tensor(xa))
+            loss = loss_fn(out, Tensor(ya))
+            return loss._value if isinstance(loss, Tensor) else loss
+
+        batch = int(np.asarray(x._value).shape[0]) if x._value.ndim else 0
+        stats = stats_from_forward(
+            fwd_loss, (np.asarray(x._value), np.asarray(y._value)),
+            model, batch=batch)
+        stats["layers"] = 1  # generic models: no auto-pipelining
+        planner = Planner(n, stats, exclusive_data_axis=True)
+        try:
+            plan = planner.plan()
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(
+                f"auto-parallel planner found no applicable plan "
+                f"({e}); keeping the default data-parallel placement")
+            return
+        self.plan_ = plan
+
+        data_ways = plan.dp * plan.sharding
+        self._pm = ProcessMesh(np.arange(n).reshape(data_ways, plan.mp),
+                               dim_names=["dp", "mp"])
+        if plan.mp > 1:
+            placements = planner.param_placements(
+                [(name, tuple(p.shape))
+                 for name, p in model.named_parameters()], plan)
+            mesh = self._pm.jax_mesh
+            for name, p in model.named_parameters():
+                spec = placements.get(name)
+                if spec and any(s is not None for s in spec):
+                    p._value = jax.device_put(
+                        p._value, NamedSharding(mesh, P(*spec)))
+        if plan.sharding > 1:
+            self.strategy = plan.to_strategy()  # _apply_strategy adds ZeRO
 
     # -- strategy ------------------------------------------------------------
     def _apply_strategy(self):
@@ -189,13 +256,17 @@ class Engine:
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=True, drop_last=True,
                                   collate_fn=collate_fn))
-        step = self._ensure_train()
+        step = None
         history = []
         for epoch in range(epochs):
             for i, batch in enumerate(loader):
                 if steps_per_epoch is not None and i >= steps_per_epoch:
                     break
                 x, y = batch[0], batch[1]
+                if step is None:
+                    if self._auto_plan_pending:
+                        self._auto_plan(x, y)
+                    step = self._ensure_train()
                 loss, out = step(self._shard_batch(np.asarray(x._value)),
                                  self._shard_batch(np.asarray(y._value)))
                 history.append(float(np.asarray(loss._value)))

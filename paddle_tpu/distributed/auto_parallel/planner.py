@@ -1,0 +1,279 @@
+"""Auto-parallel planner: search (dp, mp, pp, sharding) degrees and
+per-parameter placements from a cost model.
+
+Reference: ``auto_parallel/planner.py:829`` (``class Planner`` searching
+dist-attr assignments), ``auto_parallel/cost_model.py:192`` (``CostModel``
+simulating per-op compute/comm cost over the program graph), plus
+``tuner/`` and ``mapper.py``.
+
+TPU-native redesign: the reference simulates a program graph op-by-op
+because its partitioner must rewrite the program per plan. Here GSPMD is
+the partitioner, so a "plan" is only (a) mesh degrees and (b) sharding
+annotations — and the cost model collapses to the standard alpha-beta
+estimate over the collectives each degree implies (the scaling-book
+recipe), fed by XLA's own ``cost_analysis()`` flops for the compute term:
+
+    compute  = step_flops / (n_dev * peak * efficiency)
+    dp grads = 2 (dp-1)/dp * param_bytes / ici        (ring all-reduce)
+    mp acts  = 2 * layers * act_bytes * (mp-1)/mp / ici  (per-layer
+               all-reduce of the row-parallel partial sums)
+    sharding = dp-like reduce-scatter + all-gather on use
+    pp       = bubble (pp-1)/(microbatches + pp - 1) stretching compute
+
+Per-parameter placements: under mp, every >=2-D parameter shards its
+largest mp-divisible dim over the ``mp`` axis (GSPMD propagates the
+activation shardings and inserts the collectives — no parallel layer
+classes required); under sharding, optimizer state/gradients follow the
+ZeRO placement of ``distributed/sharding``. The emitted plan is a
+``DistributedStrategy`` whose hybrid_configs carry the degrees.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["ChipSpec", "Plan", "Planner", "plan_for"]
+
+
+@dataclass
+class ChipSpec:
+    """Per-chip peaks used by the alpha-beta estimate. Defaults: TPU v5e."""
+
+    flops: float = 197e12          # bf16 peak FLOP/s
+    hbm_bytes: float = 16e9        # HBM capacity
+    hbm_bw: float = 819e9          # HBM bandwidth B/s
+    ici_bw: float = 45e9           # per-link ICI bandwidth B/s
+    mxu_efficiency: float = 0.5    # sustained fraction of peak
+
+
+@dataclass
+class Plan:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    est_step_time: float = float("inf")
+    est_device_bytes: float = 0.0
+    feasible: bool = True
+    placements: dict = field(default_factory=dict)
+
+    @property
+    def degrees(self):
+        return dict(dp=self.dp, mp=self.mp, pp=self.pp,
+                    sharding=self.sharding)
+
+    def to_strategy(self):
+        from ..fleet.base.distributed_strategy import DistributedStrategy
+
+        s = DistributedStrategy()
+        s.hybrid_configs["dp_degree"] = self.dp
+        s.hybrid_configs["mp_degree"] = self.mp
+        s.hybrid_configs["pp_degree"] = self.pp
+        s.hybrid_configs["sharding_degree"] = self.sharding
+        if self.sharding > 1:
+            s.sharding = True
+            s.sharding_configs["stage"] = 2
+        return s
+
+
+def _factorizations(n, allow_pp):
+    """All (dp, mp, pp, sharding) with dp*mp*pp*sharding == n."""
+    divs = [d for d in range(1, n + 1) if n % d == 0]
+    for dp, mp, pp in itertools.product(divs, divs, divs):
+        if not allow_pp and pp > 1:
+            continue
+        rest = dp * mp * pp
+        if n % rest:
+            continue
+        yield dp, mp, pp, n // rest
+
+
+class Planner:
+    """Search the degree space for a model summary.
+
+    ``model_stats`` keys:
+      step_flops      — one train step's FLOPs (XLA cost_analysis; see
+                        ``stats_from_step``)
+      param_bytes     — total parameter bytes
+      opt_state_bytes — optimizer accumulator bytes (0 → 2x param fp32)
+      act_bytes       — activation bytes of ONE model pass at the global
+                        batch (bounds memory; also the mp all-reduce payload)
+      layers          — repeated-block count (pp granularity + mp comm
+                        multiplier)
+      batch           — global batch size (bounds dp*sharding)
+      mp_divisible    — largest degree that divides the model's shardable
+                        param dims (bounds mp; coarse fallback)
+      param_shapes    — optional [(bytes, shape), ...] per parameter: mp
+                        degree m is allowed when params covering >=50% of
+                        2-D bytes have some m-divisible dim (params without
+                        one replicate, which is fine for a minority)
+    """
+
+    def __init__(self, n_devices, model_stats, chip=None,
+                 num_microbatches=4, exclusive_data_axis=False):
+        self.n = int(n_devices)
+        self.stats = dict(model_stats)
+        self.chip = chip or ChipSpec()
+        self.micro = max(1, int(num_microbatches))
+        # exclusive_data_axis: only consider plans with dp==1 or
+        # sharding==1 — for appliers (like Engine) whose execution path
+        # realizes ZeRO over the WHOLE data axis and cannot express a
+        # partial dp/sharding split; keeps the ranking realizable
+        self.exclusive_data_axis = bool(exclusive_data_axis)
+
+    def _mp_ok(self, m):
+        if m == 1:
+            return True
+        shapes = self.stats.get("param_shapes")
+        if shapes:
+            two_d = [(b, s) for b, s in shapes if len(s) >= 2]
+            total = sum(b for b, _ in two_d) or 1.0
+            shardable = sum(b for b, s in two_d
+                            if any(d % m == 0 for d in s))
+            return shardable >= 0.5 * total
+        return int(self.stats.get("mp_divisible", self.n)) % m == 0
+
+    # -- cost model ----------------------------------------------------------
+    def estimate(self, dp, mp, pp, sharding):
+        st, ch = self.stats, self.chip
+        flops = float(st["step_flops"])
+        pbytes = float(st["param_bytes"])
+        obytes = float(st.get("opt_state_bytes") or 2.0 * pbytes)
+        abytes = float(st.get("act_bytes", pbytes))
+        layers = max(1, int(st.get("layers", 1)))
+
+        compute = flops / (self.n * ch.flops * ch.mxu_efficiency)
+        if pp > 1:  # pipeline bubble stretches the compute term
+            compute *= 1.0 + (pp - 1) / float(self.micro)
+
+        # per-device shard of the parameters along mp/pp
+        local_pbytes = pbytes / (mp * pp)
+        comm = 0.0
+        data_ways = dp * sharding
+        if dp > 1:
+            comm += 2.0 * local_pbytes * (dp - 1) / dp / ch.ici_bw
+        if sharding > 1:
+            # reduce-scatter grads + all-gather params-on-use (stage 2):
+            # same ring volume as an all-reduce plus the gather
+            comm += 3.0 * local_pbytes * (sharding - 1) / sharding / ch.ici_bw
+        if mp > 1:
+            # fwd+bwd row-parallel partial-sum all-reduce per layer; the
+            # payload is this device's activation slice
+            act_local = abytes / max(data_ways, 1) / pp
+            comm += 2.0 * 2.0 * act_local * (mp - 1) / mp / ch.ici_bw
+        if pp > 1:
+            # microbatch boundary sends (ppermute): tiny vs the above
+            act_local = abytes / max(data_ways, 1) / layers
+            comm += 2.0 * self.micro * act_local / ch.ici_bw
+
+        # memory: params+grads replicated over dp only; optimizer state
+        # additionally divided by the sharding degree (ZeRO stage >= 1)
+        mem = (local_pbytes * 2.0          # params + grads
+               + obytes / (mp * pp * sharding)
+               + abytes / max(data_ways, 1) / pp)
+        return compute + comm, mem
+
+    # -- search --------------------------------------------------------------
+    def enumerate_plans(self):
+        st = self.stats
+        batch = int(st.get("batch", 0) or 0)
+        layers = max(1, int(st.get("layers", 1)))
+        plans = []
+        for dp, mp, pp, sh in _factorizations(self.n, allow_pp=layers > 1):
+            if not self._mp_ok(mp):
+                continue
+            if pp > 1 and layers % pp:
+                continue
+            if batch and (dp * sh) > batch:
+                continue
+            if batch and batch % (dp * sh):
+                continue
+            if self.exclusive_data_axis and dp > 1 and sh > 1:
+                continue
+            t, mem = self.estimate(dp, mp, pp, sh)
+            plans.append(Plan(dp=dp, mp=mp, pp=pp, sharding=sh,
+                              est_step_time=t, est_device_bytes=mem,
+                              feasible=mem <= self.chip.hbm_bytes))
+        plans.sort(key=lambda p: (not p.feasible, p.est_step_time))
+        return plans
+
+    def plan(self):
+        plans = self.enumerate_plans()
+        if not plans:
+            raise ValueError(
+                f"no (dp, mp, pp, sharding) factorization of {self.n} "
+                f"devices satisfies this model's batch/divisibility "
+                f"constraints")
+        best = plans[0]
+        if not best.feasible:
+            raise ValueError(
+                f"every factorization of {self.n} devices exceeds the "
+                f"chip's {self.chip.hbm_bytes / 1e9:.0f} GB HBM (closest: "
+                f"{best.degrees} at {best.est_device_bytes / 1e9:.1f} GB) — "
+                f"reduce the model/batch or raise the device count")
+        return best
+
+    # -- per-param placements -------------------------------------------------
+    def param_placements(self, named_shapes, plan):
+        """dims_mapping per parameter for the chosen plan: under mp, shard
+        the largest mp-divisible dim of every >=2-D param over 'mp'
+        (GSPMD propagates the rest); 1-D params replicate."""
+        out = {}
+        for name, shape in named_shapes:
+            spec = [None] * len(shape)
+            if plan.mp > 1 and len(shape) >= 2:
+                order = sorted(range(len(shape)), key=lambda i: -shape[i])
+                for i in order:
+                    if shape[i] % plan.mp == 0:
+                        spec[i] = "mp"
+                        break
+            out[name] = spec
+        plan.placements = out
+        return out
+
+
+def _stats_from_cost(cost, model, batch, flops_multiplier=1.0):
+    """Shared stats assembly: XLA cost-analysis dict + model parameters →
+    the planner's model summary (single source for the heuristics)."""
+    params = list(model.parameters())
+    pbytes = float(sum(int(np.prod(p.shape)) * 4 for p in params))
+    shapes = [(int(np.prod(p.shape)) * 4, tuple(int(d) for d in p.shape))
+              for p in params]
+    dims = [d for _, s in shapes if len(s) >= 2 for d in s]
+    layer_like = [s for s in getattr(model, "_planner_layers", ()) or ()]
+    return {
+        "step_flops": flops_multiplier * cost["flops"],
+        "param_bytes": pbytes,
+        "opt_state_bytes": 2.0 * pbytes,
+        "act_bytes": max(cost["bytes_accessed"] - 2 * pbytes,
+                         0.25 * pbytes),
+        "layers": len(layer_like) or 1,
+        "batch": batch or 0,
+        "mp_divisible": int(np.gcd.reduce(dims)) if dims else 1,
+        "param_shapes": shapes,
+    }
+
+
+def stats_from_step(step_fn, example_args, model, batch=None):
+    """Planner summary from a full single-device TRAIN step: FLOPs from
+    XLA's cost analysis, parameter bytes from the model."""
+    from ...cost_model import CostModel
+
+    cost = CostModel().static_cost_data(step_fn, example_args)
+    return _stats_from_cost(cost, model, batch)
+
+
+def stats_from_forward(fwd_fn, example_args, model, batch=None):
+    """Planner summary from a forward+loss function only — the train-step
+    FLOPs are approximated as 3x forward (fwd + 2x bwd)."""
+    from ...cost_model import CostModel
+
+    cost = CostModel().static_cost_data(fwd_fn, example_args)
+    return _stats_from_cost(cost, model, batch, flops_multiplier=3.0)
+
+
+def plan_for(n_devices, model_stats, chip=None):
+    """One-call convenience: best plan for a model summary."""
+    return Planner(n_devices, model_stats, chip=chip).plan()

@@ -1,0 +1,227 @@
+"""Auto-parallel planner (round-5 VERDICT item 5): degree search from the
+alpha-beta cost model, per-param placements, Engine(strategy=None) wiring,
+and a measured best-vs-worst check on the CPU mesh.
+Reference: auto_parallel/planner.py:829, auto_parallel/cost_model.py:192."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import ChipSpec, Planner
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+def _wide_ffn_stats(batch=8):
+    """Huge weights, tiny activations: TP should win (dp's grad all-reduce
+    dwarfs mp's activation all-reduce)."""
+    return {
+        "step_flops": 1e12,
+        "param_bytes": 2e9,
+        "opt_state_bytes": 4e9,
+        "act_bytes": 1e7,
+        "layers": 1,
+        "batch": batch,
+        "mp_divisible": 8,
+    }
+
+
+def _small_model_stats(batch=64):
+    """Tiny weights, big batch/activations: pure dp should win."""
+    return {
+        "step_flops": 1e11,
+        "param_bytes": 1e6,
+        "opt_state_bytes": 2e6,
+        "act_bytes": 1e8,
+        "layers": 1,
+        "batch": batch,
+        "mp_divisible": 8,
+    }
+
+
+def test_planner_picks_mp_for_wide_ffn():
+    plan = Planner(8, _wide_ffn_stats()).plan()
+    assert plan.mp >= 2, plan.degrees
+
+
+def test_planner_picks_pure_dp_for_small_model():
+    plan = Planner(8, _small_model_stats()).plan()
+    assert plan.degrees == dict(dp=8, mp=1, pp=1, sharding=1), plan.degrees
+
+
+def test_planner_memory_forces_sharding():
+    """When replicated optimizer state overflows HBM, only ZeRO plans are
+    feasible and the planner must emit one."""
+    stats = _small_model_stats(batch=64)
+    stats["param_bytes"] = 6e9
+    stats["opt_state_bytes"] = 12e9   # >16 GB replicated: infeasible
+    stats["act_bytes"] = 1e8
+    plan = Planner(8, stats).plan()
+    assert plan.feasible
+    assert plan.sharding > 1 or plan.mp > 1, plan.degrees
+    assert plan.est_device_bytes <= ChipSpec().hbm_bytes
+
+
+def test_planner_respects_divisibility_and_batch():
+    stats = _wide_ffn_stats(batch=4)
+    stats["mp_divisible"] = 4          # mp limited to {1, 2, 4}
+    stats.pop("param_shapes", None)
+    plans = Planner(8, stats).enumerate_plans()
+    assert plans                       # satisfiable: e.g. mp=2, dp*sh=4
+    assert all(p.mp in (1, 2, 4) for p in plans)
+    assert all(p.dp * p.sharding <= 4 for p in plans)
+    # batch=4 with mp<=4 forbids dp*sh=8, so every plan uses mp>1
+    assert all(p.mp > 1 for p in plans)
+
+
+def test_planner_raises_when_nothing_fits_hbm():
+    stats = _small_model_stats(batch=64)
+    stats["param_bytes"] = 100e9       # 100 GB of params: hopeless at n=8
+    stats["opt_state_bytes"] = 200e9
+    with pytest.raises(ValueError, match="HBM"):
+        Planner(8, stats).plan()
+
+
+def test_planner_param_shapes_allow_mp_despite_odd_head():
+    """A small odd classifier head must not disable mp for a model whose
+    bytes are dominated by mp-divisible matrices (review regression)."""
+    stats = _wide_ffn_stats()
+    stats["param_shapes"] = [
+        (64 * 8192 * 4, (64, 8192)), (8192 * 64 * 4, (8192, 64)),
+        (8192 * 10 * 4, (8192, 10)),   # odd head: would gcd down to 2
+    ]
+    plan = Planner(8, stats).plan()
+    assert plan.mp >= 2, plan.degrees
+
+
+def test_engine_auto_plan_falls_back_when_unplannable():
+    """Engine(strategy=None) must keep the legacy replicated/dp behavior
+    (not crash) when no factorization fits the batch (review regression)."""
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.io import TensorDataset
+
+    paddle.seed(0)
+    model = paddle.nn.Sequential(paddle.nn.Linear(6, 10))  # gcd 2, odd dims
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    xs = np.random.RandomState(0).randn(9, 6).astype(np.float32)
+    ys = np.zeros((9, 10), np.float32)
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    eng = Engine(model=model, loss=paddle.nn.MSELoss(), optimizer=opt)
+    with pytest.warns(UserWarning, match="no applicable plan"):
+        hist = eng.fit(ds, batch_size=3, epochs=1)["loss"]
+    assert eng.plan_ is None
+    assert all(np.isfinite(v) for v in hist)
+
+
+def test_param_placements_shard_largest_divisible_dim():
+    planner = Planner(8, _wide_ffn_stats())
+    plan = planner.plan()
+    placements = planner.param_placements(
+        [("w1", (64, 8192)), ("w2", (8192, 64)), ("b", (8192,)),
+         ("odd", (7, 13))], plan)
+    assert placements["w1"] == [None, "mp"]
+    assert placements["w2"] == ["mp", None]
+    assert placements["b"] == [None]           # 1-D: replicated
+    assert placements["odd"] == [None, None]   # nothing divisible
+
+
+def _run_plan_measured(plan, iters=8):
+    """Execute a 2-layer FFN train step under the plan's placements on the
+    (dp·sharding, mp) mesh; returns min step seconds."""
+    import time
+
+    n = 8
+    d, f, batch = 256, 32768, 8
+    data_ways = plan.dp * plan.sharding
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(data_ways, plan.mp),
+                ("dp", "mp"))
+    rng = np.random.RandomState(0)
+    w1 = jnp_put(rng.randn(d, f).astype(np.float32) * 0.02, mesh,
+                 P(None, "mp") if plan.mp > 1 else P())
+    w2 = jnp_put(rng.randn(f, d).astype(np.float32) * 0.02, mesh,
+                 P("mp", None) if plan.mp > 1 else P())
+    x = jnp_put(rng.randn(batch, d).astype(np.float32), mesh, P("dp", None))
+
+    def loss_fn(w1, w2, x):
+        h = jax.nn.relu(x @ w1)
+        y = h @ w2
+        return (y * y).mean()
+
+    @jax.jit
+    def step(w1, w2, x):
+        loss, (g1, g2) = jax.value_and_grad(loss_fn, (0, 1))(w1, w2, x)
+        return w1 - 0.01 * g1, w2 - 0.01 * g2, loss
+
+    w1, w2, loss = step(w1, w2, x)   # compile
+    jax.block_until_ready(loss)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        w1, w2, loss = step(w1, w2, x)
+        jax.block_until_ready(loss)
+        times.append(time.perf_counter() - t0)
+    return float(np.min(times))
+
+
+def jnp_put(a, mesh, spec):
+    return jax.device_put(a, NamedSharding(mesh, spec))
+
+
+def test_planner_choice_beats_worst_measured():
+    """The planner's pick must beat the worst enumerated plan in MEASURED
+    CPU-mesh step time (VERDICT done-criterion). The wide-FFN shape makes
+    dp's 32 MB grad all-reduce the dominant cost, which both the model and
+    the measurement agree on."""
+    d, f = 256, 32768
+    pbytes = (d * f + f * d) * 4.0
+    stats = {
+        "step_flops": 6.0 * 8 * (d * f + f * d),
+        "param_bytes": pbytes,
+        "opt_state_bytes": 2 * pbytes,
+        "act_bytes": 8 * (d + f) * 4.0,
+        "layers": 1,
+        "batch": 8,
+        "mp_divisible": int(np.gcd(d, f)),
+    }
+    planner = Planner(8, stats)
+    plans = [p for p in planner.enumerate_plans()
+             if p.feasible and p.pp == 1 and p.sharding == 1]
+    best, worst = plans[0], plans[-1]
+    assert best.degrees != worst.degrees
+    t_best = _run_plan_measured(best)
+    t_worst = _run_plan_measured(worst)
+    assert t_best <= t_worst * 1.10, (
+        f"planner pick {best.degrees} ({t_best*1e3:.2f} ms) not faster than "
+        f"worst {worst.degrees} ({t_worst*1e3:.2f} ms)")
+
+
+def test_engine_auto_plans_without_strategy():
+    """Engine(strategy=None) on a multi-device mesh runs the planner on the
+    first batch, applies the placements, and trains."""
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    d, f = 64, 4096
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(d, f), paddle.nn.ReLU(), paddle.nn.Linear(f, d))
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    loss = paddle.nn.MSELoss()
+
+    xs = np.random.RandomState(0).randn(32, d).astype(np.float32)
+    ys = np.zeros((32, d), np.float32)
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+
+    eng = Engine(model=model, loss=loss, optimizer=opt)
+    loader = DataLoader(ds, batch_size=8, shuffle=False, drop_last=True)
+    hist = eng.fit(loader, epochs=2)["loss"]
+    assert eng.plan_ is not None
+    assert eng.plan_.dp * eng.plan_.mp * eng.plan_.sharding == 8
+    assert all(np.isfinite(v) for v in hist)
+    # same 4 batches each epoch: the second pass must be cheaper on average
+    assert np.mean(hist[4:]) < np.mean(hist[:4])
