@@ -1,0 +1,349 @@
+"""Post-training quantization (reference
+``fluid/contrib/slim/quantization/post_training_quantization.py`` +
+``cal_kl_threshold.py``; re-exported as ``paddle.static.quantization``).
+
+TPU-native redesign: the reference walks a static ProgramDesc, inserting
+fake-quant ops and running the program op-by-op to sample activations.
+Here calibration runs the DYGRAPH model under forward hooks (one jitted
+forward per calibration batch), observers accumulate per-layer activation
+ranges/histograms on the host, and "emitting the quantized model" swaps
+every Linear/Conv2D for a static-scale quantized twin whose weights are
+stored as int8 (+ per-channel fp scales) and whose activations
+quant-dequant with the calibrated threshold — one fused XLA elementwise
+chain in front of each matmul/conv, jit/save-compatible through the
+Predictor path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "cal_kl_threshold",
+    "PostTrainingQuantization",
+    "QuantizedInferenceLinear",
+    "QuantizedInferenceConv2D",
+]
+
+
+# ---------------------------------------------------------------------------
+# KL threshold search (reference cal_kl_threshold.py:75)
+# ---------------------------------------------------------------------------
+
+def _smoothed(p, eps=1e-7):
+    """Distribute a small mass onto empty bins so KL is finite (the
+    reference's smoothing step)."""
+    p = p.astype(np.float64)
+    is_zero = p == 0
+    n_zero = int(is_zero.sum())
+    if n_zero == 0 or n_zero == p.size:
+        return p
+    shift = eps * float((~is_zero).sum()) / n_zero
+    return np.where(is_zero, shift, p - eps)
+
+
+def _kl_divergence(p, q):
+    p = _smoothed(p / max(p.sum(), 1e-12))
+    q = _smoothed(q / max(q.sum(), 1e-12))
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+
+
+def cal_kl_threshold(hist, bin_width, bits):
+    """Pick the |activation| threshold minimizing KL(P||Q) between the
+    calibration histogram P and its ``2**(bits-1)`` - level quantized
+    reconstruction Q (reference ``cal_kl_threshold.py:75``)."""
+    hist = np.asarray(hist, np.float64)
+    n_bins = hist.size
+    levels = 2 ** (bits - 1)
+    if n_bins <= levels:
+        return float(bin_width * n_bins)
+    best_i, best_kl = n_bins, float("inf")
+    for i in range(levels, n_bins + 1):
+        ref = hist[:i].copy()
+        # outliers clip into the last kept bin
+        ref[i - 1] += hist[i:].sum()
+        # quantize the kept range to `levels` buckets, then expand back
+        candidate = hist[:i]
+        bucket = i / float(levels)
+        q = np.zeros(i)
+        for lv in range(levels):
+            lo, hi = int(np.floor(lv * bucket)), int(np.ceil((lv + 1) * bucket))
+            hi = min(hi, i)
+            seg = candidate[lo:hi]
+            nz = seg > 0
+            if nz.any():
+                q[lo:hi][nz] = seg[nz].sum() / int(nz.sum())
+        kl = _kl_divergence(ref, q)
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return float(bin_width * best_i)
+
+
+# ---------------------------------------------------------------------------
+# observers
+# ---------------------------------------------------------------------------
+
+class _Observer:
+    """Accumulates per-layer input-activation statistics over calibration
+    batches; ``threshold(bits)`` yields the quantization range."""
+
+    def __init__(self, algo="KL", bins=2048, hist_percent=0.99999):
+        self.algo = algo
+        self.bins = bins
+        self.hist_percent = hist_percent
+        self.abs_max = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self.avg_absmax = []
+        self.hist = None
+        self.hist_width = None
+        self._pending = []
+
+    def observe(self, arr):
+        arr = np.asarray(arr, np.float32)
+        amax = float(np.abs(arr).max()) if arr.size else 0.0
+        self.abs_max = max(self.abs_max, amax)
+        self.min = min(self.min, float(arr.min()) if arr.size else 0.0)
+        self.max = max(self.max, float(arr.max()) if arr.size else 0.0)
+        self.avg_absmax.append(amax)
+        if self.algo in ("KL", "hist"):
+            # two-pass-free histogram: keep raw samples until the range is
+            # known would blow memory; instead grow the histogram by
+            # rescaling when a new max arrives (standard streaming trick)
+            if self.hist is None:
+                self.hist_width = max(amax, 1e-8) / self.bins
+                self.hist = np.zeros(self.bins, np.float64)
+            elif amax > self.hist_width * self.bins:
+                new_width = amax / self.bins
+                ratio = new_width / self.hist_width
+                idx = np.minimum((np.arange(self.bins) / ratio).astype(int),
+                                 self.bins - 1)
+                rebinned = np.zeros(self.bins, np.float64)
+                np.add.at(rebinned, idx, self.hist)
+                self.hist, self.hist_width = rebinned, new_width
+            h, _ = np.histogram(np.abs(arr),
+                                bins=self.bins,
+                                range=(0.0, self.hist_width * self.bins))
+            self.hist += h
+
+    def threshold(self, bits=8):
+        if self.algo == "abs_max":
+            return self.abs_max
+        if self.algo == "min_max":
+            return max(abs(self.min), abs(self.max))
+        if self.algo == "avg":
+            return float(np.mean(self.avg_absmax)) if self.avg_absmax else 0.0
+        if self.algo == "hist":
+            c = np.cumsum(self.hist)
+            if c[-1] <= 0:
+                return self.abs_max
+            i = int(np.searchsorted(c, self.hist_percent * c[-1]))
+            return float(self.hist_width * (i + 1))
+        if self.algo == "KL":
+            if self.hist is None or self.hist.sum() == 0:
+                return self.abs_max
+            return cal_kl_threshold(self.hist, self.hist_width, bits)
+        raise ValueError(f"unsupported algo {self.algo!r}")
+
+
+# ---------------------------------------------------------------------------
+# quantized inference layers (static scales, int8 weights)
+# ---------------------------------------------------------------------------
+
+def _channel_scales(w, axis, qmax):
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    s = jnp.max(jnp.abs(w), axis=red, keepdims=True)
+    return jnp.maximum(s, 1e-8) / qmax
+
+
+def _quantize_weight(w, axis, bits, channel_wise):
+    qmax = float(2 ** (bits - 1) - 1)
+    if channel_wise:
+        scale = _channel_scales(w, axis, qmax)
+    else:
+        scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-8) / qmax
+    wq = jnp.clip(jnp.round(w / scale), -qmax, qmax).astype(jnp.int8)
+    return wq, scale.astype(jnp.float32)
+
+
+def _act_qdq(x, threshold, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = max(float(threshold), 1e-8) / qmax
+    return jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+
+
+class QuantizedInferenceLinear(Layer):
+    """Linear with int8 weights + per-out-channel scales and a calibrated
+    static activation threshold (the emitted form of the reference's
+    quantized inference program)."""
+
+    def __init__(self, layer: Linear, act_threshold, weight_bits=8,
+                 activation_bits=8, channel_wise=True):
+        super().__init__()
+        self.act_threshold = float(act_threshold)
+        self.activation_bits = activation_bits
+        wq, scale = _quantize_weight(layer.weight._value, 1, weight_bits,
+                                     channel_wise)
+        self.register_buffer("weight_int8", Tensor(wq))
+        self.register_buffer("weight_scale", Tensor(scale))
+        self.bias = layer.bias
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xv = _act_qdq(x._value if isinstance(x, Tensor) else x,
+                      self.act_threshold, self.activation_bits)
+        w = (self.weight_int8._value.astype(jnp.float32)
+             * self.weight_scale._value)
+        return F.linear(Tensor(xv), Tensor(w), self.bias)
+
+
+class QuantizedInferenceConv2D(Layer):
+    def __init__(self, layer: Conv2D, act_threshold, weight_bits=8,
+                 activation_bits=8, channel_wise=True):
+        super().__init__()
+        self.act_threshold = float(act_threshold)
+        self.activation_bits = activation_bits
+        wq, scale = _quantize_weight(layer.weight._value, 0, weight_bits,
+                                     channel_wise)
+        self.register_buffer("weight_int8", Tensor(wq))
+        self.register_buffer("weight_scale", Tensor(scale))
+        self.bias = layer.bias
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xv = _act_qdq(x._value if isinstance(x, Tensor) else x,
+                      self.act_threshold, self.activation_bits)
+        w = (self.weight_int8._value.astype(jnp.float32)
+             * self.weight_scale._value)
+        return F.conv2d(Tensor(xv), Tensor(w), self.bias,
+                        stride=self._stride, padding=self._padding,
+                        dilation=self._dilation, groups=self._groups)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+class PostTrainingQuantization:
+    """Observer-based PTQ (reference
+    ``post_training_quantization.py PostTrainingQuantization``).
+
+    TPU-native constructor: a dygraph ``model`` + ``data_loader`` of
+    calibration batches (each batch an input Tensor or a (inputs, ...)
+    tuple whose first element feeds the model).
+
+    ``algo``: 'KL' (histogram + KL-divergence threshold), 'hist'
+    (percentile), 'avg' (mean abs-max over batches), 'abs_max', 'min_max'.
+    Weights quantize per-out-channel abs-max ('channel_wise_abs_max',
+    the reference default) or per-tensor ('abs_max')."""
+
+    def __init__(self, model=None, data_loader=None, batch_nums=None,
+                 algo="KL", hist_percent=0.99999, bins=2048,
+                 quantizable_op_type=("conv2d", "linear"),
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 executor=None, scope=None, **_legacy):
+        if model is None or data_loader is None:
+            raise ValueError(
+                "PostTrainingQuantization needs model= and data_loader=")
+        if algo not in ("KL", "hist", "avg", "abs_max", "min_max"):
+            raise ValueError(
+                "algo should be KL, hist, avg, abs_max or min_max")
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(
+                "weight_quantize_type should be abs_max or "
+                "channel_wise_abs_max")
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = batch_nums
+        self._algo = algo
+        self._bins = bins
+        self._hist_percent = hist_percent
+        self._types = tuple(quantizable_op_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._channel_wise = weight_quantize_type == "channel_wise_abs_max"
+        self._observers = {}
+        self.activation_thresholds = {}
+
+    def _target_layers(self):
+        for name, sub in self._model.named_sublayers():
+            if isinstance(sub, Linear) and "linear" in self._types:
+                yield name, sub
+            elif isinstance(sub, Conv2D) and "conv2d" in self._types:
+                yield name, sub
+
+    def quantize(self):
+        """Run calibration, compute thresholds, and return the quantized
+        model (the reference mutates its program; here the model's
+        Linear/Conv2D sublayers are swapped for quantized twins)."""
+        handles = []
+        observers = self._observers
+        for name, sub in self._target_layers():
+            obs = observers.setdefault(
+                name, _Observer(self._algo, self._bins, self._hist_percent))
+
+            def hook(layer, inputs, _obs=obs):
+                x = inputs[0]
+                _obs.observe(np.asarray(
+                    x._value if isinstance(x, Tensor) else x))
+
+            handles.append(sub.register_forward_pre_hook(hook))
+
+        was_training = self._model.training
+        self._model.eval()
+        try:
+            for i, batch in enumerate(self._loader):
+                if self._batch_nums is not None and i >= self._batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self._model(x if isinstance(x, Tensor) else Tensor(x))
+        finally:
+            for h in handles:
+                h.remove()
+            if was_training:
+                self._model.train()
+
+        for name, obs in observers.items():
+            self.activation_thresholds[name] = obs.threshold(self._abits)
+
+        self._swap(self._model, prefix="")
+        return self._model
+
+    def _swap(self, layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}{name}" if not prefix else f"{prefix}.{name}"
+            if full in self.activation_thresholds:
+                thr = self.activation_thresholds[full]
+                if isinstance(sub, Linear):
+                    layer._sub_layers[name] = QuantizedInferenceLinear(
+                        sub, thr, self._wbits, self._abits,
+                        self._channel_wise)
+                elif isinstance(sub, Conv2D):
+                    layer._sub_layers[name] = QuantizedInferenceConv2D(
+                        sub, thr, self._wbits, self._abits,
+                        self._channel_wise)
+            else:
+                self._swap(sub, full)
+
+    def save_quantized_model(self, save_model_path, model_filename=None,
+                             params_filename=None, input_spec=None):
+        """Persist through the jit/Predictor path (reference emits an
+        inference program + params)."""
+        from .. import jit
+
+        jit.save(self._model, save_model_path, input_spec=input_spec)
+        return save_model_path

@@ -38,3 +38,7 @@ __all__ = [
     "CompiledProgram", "append_backward", "save_inference_model",
     "load_inference_model", "nn", "global_scope", "in_static_build",
 ]
+from . import quantization  # noqa: F401  (reference static/quantization/)
+from .sharding import shard_static_optimizer  # noqa: F401
+
+__all__ += ["quantization", "shard_static_optimizer"]

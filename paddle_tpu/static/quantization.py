@@ -1,0 +1,11 @@
+"""``paddle.static.quantization`` module path (reference
+``python/paddle/static/quantization/``): deployment code imports this as a
+real submodule (``import paddle.static.quantization as q``), so it exists
+as a module shim over :mod:`paddle_tpu.quantization`."""
+from ..quantization import *  # noqa: F401,F403
+from ..quantization import (  # noqa: F401
+    PostTrainingQuantization,
+    QuantizedInferenceConv2D,
+    QuantizedInferenceLinear,
+    cal_kl_threshold,
+)

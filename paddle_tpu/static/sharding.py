@@ -1,0 +1,46 @@
+"""ZeRO stage-1 for static Programs (round-5 VERDICT item 6).
+
+Reference: ``fleet/meta_optimizers/sharding_optimizer.py:46`` — a
+ProgramDesc rewrite that segments the program, assigns each parameter's
+optimizer state to one rank, and inserts broadcast/allreduce ops so every
+rank updates only its shard.
+
+TPU-native redesign: a static Program already replays as ONE jitted SPMD
+step (``static/executor.py``), and its registered optimizers run the same
+accumulator machinery as eager mode — so the stage-1 "rewrite" collapses
+to attaching the ZeRO placement hook (``distributed/sharding``): every
+optimizer accumulator (moments, master weights) materializes sharded over
+the sharding group's mesh axis, XLA's partitioner inserts the
+gather/scatter the reference hand-codes, and per-device optimizer-state
+memory drops to 1/nranks. Stage-2/3 (grad + param sharding) remain
+jit-SPMD-path features (``group_sharded_parallel``); pipeline-stage
+splitting of serialized Programs stays descoped — see COVERAGE.md.
+"""
+from __future__ import annotations
+
+__all__ = ["shard_static_optimizer"]
+
+
+def shard_static_optimizer(program, group=None, offload=False):
+    """Apply ZeRO stage-1 placement to every optimizer registered on the
+    ``program`` (i.e. those whose ``minimize(loss)`` ran under this
+    program's guard). Call after ``minimize``; the next ``Executor.run``
+    materializes all optimizer accumulators sharded over ``group``'s mesh
+    axis.
+
+    Returns the program (for chaining)."""
+    from ..distributed.sharding.group_sharded import (
+        _sharding_group,
+        _shard_value,
+    )
+
+    g = _sharding_group(group)
+    if not getattr(program, "_optimizers", None):
+        raise ValueError(
+            "shard_static_optimizer: the program has no registered "
+            "optimizer — call optimizer.minimize(loss) under the "
+            "program guard first")
+    for opt, _loss in program._optimizers:
+        opt._accumulator_transform = (
+            lambda arr, _g=g: _shard_value(arr, _g, offload=offload))
+    return program

@@ -192,6 +192,32 @@ def test_multi_tile_causal_boundary_grads_zero():
         assert err < 1e-4, (name, err)
 
 
+def test_bias_fully_masked_rows_multi_tile():
+    """Review regression: a shared padding bias can fully mask rows in ANY
+    tile (not just causal-boundary ones) — the multi-tile forward and
+    fused backward must zero those rows even on interior/non-causal
+    paths."""
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (1, 512, 2 * 64), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 512, 2 * 64), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 512, 2 * 64), jnp.float32)
+    # rows 0..63 are pad queries: every key masked for them
+    keep = np.ones((512, 512), bool)
+    keep[:64, :] = False
+    co = jax.random.normal(jax.random.key(22), q.shape, jnp.float32)
+
+    def f(q, k, v):
+        out = flash_attention_packed(q, k, v, 2, bias=jnp.asarray(keep),
+                                     causal=False, block_q=256, block_k=128,
+                                     bwd_block=256, interpret=True)
+        return jnp.vdot(out, co), out
+
+    (_, out), grads = jax.value_and_grad(f, (0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_array_equal(np.asarray(out[0, :64]), 0.0)
+    np.testing.assert_array_equal(np.asarray(grads[0][0, :64]), 0.0)
+    assert np.abs(np.asarray(out[0, 64:])).max() > 0
+
+
 def test_single_tile_causal_fully_masked_rows():
     """Review regression: sq > sk causal with one k tile — query rows with
     no visible keys must output 0 (not the mean of v)."""

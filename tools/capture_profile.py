@@ -14,6 +14,13 @@ import numpy as np
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=16)
+    a = ap.parse_args()
+
     import jax
 
     import paddle_tpu as paddle
@@ -22,9 +29,9 @@ def main():
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
     cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                    num_heads=12, max_position_embeddings=1024,
+                    num_heads=12, max_position_embeddings=a.seq,
                     hidden_dropout=0.0, attention_dropout=0.0)
-    batch, seq = 16, 1024
+    batch, seq = a.batch, a.seq
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
     model.to(dtype="bfloat16")

@@ -9,10 +9,12 @@ virtual mesh; this tool shows the single-chip long-seq numbers the ring
 composes from.
 
 Run: python tools/long_context_bench.py [--seqs 2048,4096,8192]
+Writes LONGCTX_r05.json at the repo root when run on TPU hardware.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import time
 
 import numpy as np
@@ -21,7 +23,10 @@ import numpy as np
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", default="2048,4096,8192")
-    ap.add_argument("--tokens-per-batch", type=int, default=16384)
+    # per-seq batch optima measured on v5e (r5): s2048 b16 > b12/b8;
+    # s4096 b6 > b4/b8; s8192 b4 > b2/b3/b6
+    ap.add_argument("--tokens-per-batch", type=int, default=0)
+    ap.add_argument("--no-artifact", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -32,8 +37,13 @@ def main():
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
     on_tpu = jax.default_backend() != "cpu"
+    results = []
+    MEASURED_BATCH = {2048: 16, 4096: 6, 8192: 4}
     for seq in [int(s) for s in args.seqs.split(",")]:
-        batch = max(1, args.tokens_per_batch // seq)
+        if args.tokens_per_batch:
+            batch = max(1, args.tokens_per_batch // seq)
+        else:
+            batch = MEASURED_BATCH.get(seq, max(1, 32768 // seq))
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_heads=12, max_position_embeddings=seq,
                         hidden_dropout=0.0, attention_dropout=0.0)
@@ -78,6 +88,15 @@ def main():
         print(f"seq={seq:6d} batch={batch:3d}: {dt * 1e3:8.1f} ms/step "
               f"{toks:9.0f} tok/s  mfu={mfu:.3f}  loss={last:.3f}",
               flush=True)
+        results.append({"seq": seq, "batch": batch,
+                        "ms_per_step": round(dt * 1e3, 1),
+                        "tokens_per_sec": round(toks, 1),
+                        "mfu": round(mfu, 4) if np.isfinite(mfu) else None})
+        jax.clear_caches()
+    if on_tpu and not args.no_artifact:
+        with open("LONGCTX_r05.json", "w") as f:
+            json.dump({"results": results}, f, indent=1)
+            f.write("\n")
 
 
 if __name__ == "__main__":

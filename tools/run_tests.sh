@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Sharded CPU test run (round-5 VERDICT item 9: one -x failure late in a
+# cold serial run costs half an hour).
+#
+#   tools/run_tests.sh            # sharded across 4 workers (~3x faster cold)
+#   tools/run_tests.sh -n 8      # custom worker count / extra pytest args
+#
+# --dist loadfile keeps every test file on one worker: the launch/elastic
+# tests spawn their own 2-process jobs and the per-file jax fixtures
+# (virtual 8-device CPU mesh, persistent compile cache keyed by host CPU)
+# stay coherent. The persistent XLA:CPU cache in /tmp/jax_pt_cache_* is
+# shared across workers and across runs — a warm sharded run is ~3 min.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=("$@")
+if [[ ! " ${ARGS[*]-} " =~ " -n " ]]; then
+  ARGS=(-n 4 "${ARGS[@]-}")
+fi
+
+PYTHONPATH="/root/.axon_site:$(pwd)${PYTHONPATH:+:$PYTHONPATH}" \
+  exec python -m pytest tests/ -q -p no:cacheprovider \
+    --dist loadfile "${ARGS[@]}"
