@@ -1,0 +1,103 @@
+"""Minimal protobuf wire-format codec for the ONNX schema subset the
+exporter emits (``onnx/onnx.proto`` field numbers; the ``onnx`` package is
+not installable in this offline environment, and the wire format is a
+stable public spec: varint tags, length-delimited submessages).
+
+Writer: nested dict/list structures -> bytes. Reader: bytes -> the same
+structures (used by the tests to round-trip and by ``load`` for
+inspection). Only the field kinds the exporter uses are implemented:
+varint int, float (fixed32 via packed floats list), string/bytes,
+repeated submessage.
+"""
+from __future__ import annotations
+
+import struct
+
+# wire types
+_VARINT, _I64, _LEN, _I32 = 0, 1, 2, 5
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit (negative enums/ints)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def emit_int(field, value):
+    return _tag(field, _VARINT) + _varint(int(value))
+
+
+def emit_bytes(field, value):
+    if isinstance(value, str):
+        value = value.encode()
+    return _tag(field, _LEN) + _varint(len(value)) + value
+
+
+def emit_msg(field, payload: bytes):
+    return _tag(field, _LEN) + _varint(len(payload)) + payload
+
+
+def emit_packed_floats(field, values):
+    body = b"".join(struct.pack("<f", float(v)) for v in values)
+    return _tag(field, _LEN) + _varint(len(body)) + body
+
+
+def emit_packed_ints(field, values):
+    body = b"".join(_varint(int(v)) for v in values)
+    return _tag(field, _LEN) + _varint(len(body)) + body
+
+
+# -- reader ------------------------------------------------------------------
+
+def _read_varint(buf, i):
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse(buf):
+    """Decode one message level into {field: [raw values]} — varints as
+    ints, LEN fields as bytes (caller recurses with `parse` where a
+    submessage is expected)."""
+    out = {}
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == _VARINT:
+            v, i = _read_varint(buf, i)
+        elif wire == _LEN:
+            ln, i = _read_varint(buf, i)
+            v = bytes(buf[i:i + ln])
+            i += ln
+        elif wire == _I32:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == _I64:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:  # pragma: no cover
+            raise ValueError(f"unsupported wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def unpack_floats(raw: bytes):
+    return list(struct.unpack(f"<{len(raw) // 4}f", raw))

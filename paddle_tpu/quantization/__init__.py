@@ -318,6 +318,17 @@ class PostTrainingQuantization:
                 self._model.train()
 
         for name, obs in observers.items():
+            if not obs.avg_absmax or obs.abs_max == 0.0:
+                # a layer the calibration batches never exercised (aux
+                # head, disabled branch): quantizing it with threshold 0
+                # would silently collapse its activations — keep it fp32
+                # and say so
+                import warnings
+
+                warnings.warn(
+                    f"PostTrainingQuantization: layer {name!r} received no "
+                    f"calibration activations; leaving it unquantized")
+                continue
             self.activation_thresholds[name] = obs.threshold(self._abits)
 
         self._swap(self._model, prefix="")

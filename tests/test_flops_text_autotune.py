@@ -65,12 +65,10 @@ def test_onnx_exports_stablehlo(tmp_path):
 
     lin = paddle.nn.Linear(4, 2)
     path = str(tmp_path / "m")
-    # default onnx format: raises loudly (no .onnx can be produced here) —
-    # never a warning that leaves the named artifact unwritten
-    with pytest.raises(RuntimeError, match="onnx"):
-        paddle.onnx.export(lin, path,
-                           input_spec=[InputSpec([2, 4], "float32")])
-    assert not os.path.exists(path + ".pdmodel")
+    # round-5: the default onnx format now writes a real .onnx artifact
+    out = paddle.onnx.export(lin, path,
+                             input_spec=[InputSpec([2, 4], "float32")])
+    assert out == path + ".onnx" and os.path.exists(out)
     # explicit StableHLO opt-in writes the portable artifact
     out = paddle.onnx.export(lin, path, format_="stablehlo",
                              input_spec=[InputSpec([2, 4], "float32")])

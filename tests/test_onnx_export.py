@@ -1,0 +1,119 @@
+"""ONNX artifact production (round-5 VERDICT missing #4): the static
+Program -> ONNX emitter writes real ModelProto files for the vision-zoo
+op set, round-tripped through the in-tree protobuf reader.
+Reference: python/paddle/onnx/export.py (paddle2onnx)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.save_load import InputSpec
+from paddle_tpu.onnx import export, load_structure
+from paddle_tpu.utils import unique_name
+
+
+def test_lenet_onnx_structure(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+
+    with unique_name.guard():
+        paddle.seed(0)
+        model = LeNet(num_classes=10)
+    path = export(model, str(tmp_path / "lenet"),
+                  input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    s = load_structure(path)
+    assert s["ir_version"] == 8 and s["opset"] == 13
+    ops = [n["op_type"] for n in s["nodes"]]
+    assert ops.count("Conv") == 2
+    assert ops.count("MaxPool") == 2
+    assert ops.count("Gemm") == 3
+    assert "Flatten" in ops and "Relu" in ops
+    assert s["inputs"] == ["input_0"] and len(s["outputs"]) == 1
+    # the graph is connected: every node input is a graph input, an
+    # initializer, or a prior node's output
+    known = set(s["inputs"]) | set(s["initializers"])
+    for n in s["nodes"]:
+        for i in n["inputs"]:
+            assert i in known, (n["op_type"], i)
+        known |= set(n["outputs"])
+    assert s["outputs"][0] in known
+
+
+def test_lenet_onnx_weights_roundtrip(tmp_path):
+    """Initializer payloads are the exact fp32 parameter values (checked
+    through the wire-format reader, not the writer's own dicts)."""
+    from paddle_tpu.vision.models import LeNet
+
+    with unique_name.guard():
+        paddle.seed(1)
+        model = LeNet(num_classes=10)
+    path = export(model, str(tmp_path / "lenet_w"),
+                  input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    s = load_structure(path)
+    conv1_w = np.asarray(model.features[0].weight._value)
+    gemm_ws = [a for a in s["initializers"].values()
+               if a.shape == tuple(model.fc[0].weight.shape)]
+    conv_ws = [a for a in s["initializers"].values()
+               if a.shape == conv1_w.shape]
+    assert any(np.allclose(a, conv1_w) for a in conv_ws)
+    fc1_w = np.asarray(model.fc[0].weight._value)
+    assert any(np.allclose(a, fc1_w) for a in gemm_ws)
+
+
+def test_resnet18_onnx_structure(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+
+    with unique_name.guard():
+        paddle.seed(2)
+        model = resnet18(num_classes=10)
+    path = export(model, str(tmp_path / "r18"),
+                  input_spec=[InputSpec([None, 3, 32, 32], "float32")])
+    s = load_structure(path)
+    ops = [n["op_type"] for n in s["nodes"]]
+    assert ops.count("Conv") == 20
+    assert ops.count("BatchNormalization") == 20
+    assert ops.count("Add") == 8            # residual joins
+    assert ops.count("GlobalAveragePool") == 1
+    assert ops.count("Gemm") == 1
+    # BatchNormalization input order is (x, scale, B, mean, var): scale is
+    # all-ones at init, running var is all-ones too, but mean is zeros —
+    # check slot 3 maps to the zeros initializer
+    bn = next(n for n in s["nodes"] if n["op_type"] == "BatchNormalization")
+    mean_init = s["initializers"][bn["inputs"][3]]
+    assert np.allclose(mean_init, 0.0)
+    var_init = s["initializers"][bn["inputs"][4]]
+    assert np.allclose(var_init, 1.0)
+
+
+def test_unmapped_op_raises_with_name(tmp_path):
+    class Odd(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.erf(x)
+
+    with pytest.raises(NotImplementedError, match="erf"):
+        export(Odd(), str(tmp_path / "odd"),
+               input_spec=[InputSpec([None, 4], "float32")])
+
+
+def test_export_requires_input_spec(tmp_path):
+    with pytest.raises(ValueError, match="input_spec"):
+        export(paddle.nn.Linear(2, 2), str(tmp_path / "x"))
+
+
+def test_string_padding_raises_clearly(tmp_path):
+    class SamePad(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.c = paddle.nn.Conv2D(1, 2, 3, padding="same")
+
+        def forward(self, x):
+            return self.c(x)
+
+    with pytest.raises(NotImplementedError, match="padding"):
+        export(SamePad(), str(tmp_path / "sp"),
+               input_spec=[InputSpec([None, 1, 8, 8], "float32")])
+
+
+def test_unsupported_opset_raises(tmp_path):
+    with pytest.raises(ValueError, match="opset"):
+        export(paddle.nn.Linear(2, 2), str(tmp_path / "o9"),
+               input_spec=[InputSpec([None, 2], "float32")],
+               opset_version=9)

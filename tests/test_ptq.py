@@ -158,3 +158,34 @@ def test_ptq_saves_through_jit(tmp_path):
     loaded = paddle.jit.load(path)
     out = _np(loaded(Tensor(xs[:8])))
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ptq_skips_unobserved_layer_with_warning():
+    """Review regression: a layer the calibration batches never exercise
+    must stay fp32 (not get a zero threshold that collapses activations)."""
+    import warnings
+
+    class TwoHeads(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.used = paddle.nn.Linear(4, 4)
+            self.unused = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.used(x)
+
+    paddle.seed(3)
+    m = TwoHeads()
+    x = Tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    ref = np.asarray(m(x)._value)
+    ptq = PostTrainingQuantization(model=m, data_loader=[(x,)],
+                                   algo="abs_max")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        qm = ptq.quantize()
+    assert any("unused" in str(x.message) for x in w)
+    kinds = {n: type(s).__name__ for n, s in qm.named_sublayers()}
+    assert kinds["used"] == "QuantizedInferenceLinear"
+    assert kinds["unused"] == "Linear"        # untouched
+    got = np.asarray(qm(x)._value)
+    np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
