@@ -27,6 +27,7 @@ from ..nn.layer.layers import Layer
 __all__ = [
     "cal_kl_threshold",
     "PostTrainingQuantization",
+    "WeightQuantization",
     "QuantizedInferenceLinear",
     "QuantizedInferenceConv2D",
 ]
@@ -182,12 +183,14 @@ def _act_qdq(x, threshold, bits):
 class QuantizedInferenceLinear(Layer):
     """Linear with int8 weights + per-out-channel scales and a calibrated
     static activation threshold (the emitted form of the reference's
-    quantized inference program)."""
+    quantized inference program). ``act_threshold=None`` = weight-only
+    quantization (activations pass through fp32)."""
 
     def __init__(self, layer: Linear, act_threshold, weight_bits=8,
                  activation_bits=8, channel_wise=True):
         super().__init__()
-        self.act_threshold = float(act_threshold)
+        self.act_threshold = (None if act_threshold is None
+                              else float(act_threshold))
         self.activation_bits = activation_bits
         wq, scale = _quantize_weight(layer.weight._value, 1, weight_bits,
                                      channel_wise)
@@ -198,8 +201,9 @@ class QuantizedInferenceLinear(Layer):
     def forward(self, x):
         from ..nn import functional as F
 
-        xv = _act_qdq(x._value if isinstance(x, Tensor) else x,
-                      self.act_threshold, self.activation_bits)
+        xv = x._value if isinstance(x, Tensor) else x
+        if self.act_threshold is not None:
+            xv = _act_qdq(xv, self.act_threshold, self.activation_bits)
         w = (self.weight_int8._value.astype(jnp.float32)
              * self.weight_scale._value)
         return F.linear(Tensor(xv), Tensor(w), self.bias)
@@ -209,7 +213,8 @@ class QuantizedInferenceConv2D(Layer):
     def __init__(self, layer: Conv2D, act_threshold, weight_bits=8,
                  activation_bits=8, channel_wise=True):
         super().__init__()
-        self.act_threshold = float(act_threshold)
+        self.act_threshold = (None if act_threshold is None
+                              else float(act_threshold))
         self.activation_bits = activation_bits
         wq, scale = _quantize_weight(layer.weight._value, 0, weight_bits,
                                      channel_wise)
@@ -224,8 +229,9 @@ class QuantizedInferenceConv2D(Layer):
     def forward(self, x):
         from ..nn import functional as F
 
-        xv = _act_qdq(x._value if isinstance(x, Tensor) else x,
-                      self.act_threshold, self.activation_bits)
+        xv = x._value if isinstance(x, Tensor) else x
+        if self.act_threshold is not None:
+            xv = _act_qdq(xv, self.act_threshold, self.activation_bits)
         w = (self.weight_int8._value.astype(jnp.float32)
              * self.weight_scale._value)
         return F.conv2d(Tensor(xv), Tensor(w), self.bias,
@@ -358,3 +364,47 @@ class PostTrainingQuantization:
 
         jit.save(self._model, save_model_path, input_spec=input_spec)
         return save_model_path
+
+
+class WeightQuantization:
+    """Weight-only quantization (reference
+    ``post_training_quantization.py WeightQuantization``): no calibration
+    data — Linear/Conv2D weights store as per-channel int8 (or per-tensor),
+    activations pass through fp32. The reference operates on a saved
+    inference model directory; TPU-native form takes the dygraph model (or
+    a ``paddle.jit.save`` path, loaded via the Predictor route).
+    """
+
+    def __init__(self, model=None, model_dir=None, model_filename=None,
+                 params_filename=None):
+        if model is None and model_dir is None:
+            raise ValueError("WeightQuantization needs model= or model_dir=")
+        if model is None:
+            from .. import jit
+
+            model = jit.load(model_dir)
+        self._model = model
+
+    def quantize_weight_to_int(self, save_model_dir=None, weight_bits=8,
+                               quantizable_op_type=("conv2d", "linear"),
+                               weight_quantize_type="channel_wise_abs_max",
+                               generate_test_model=False, threshold_rate=0.0):
+        channel_wise = weight_quantize_type == "channel_wise_abs_max"
+        self._swap(self._model, tuple(quantizable_op_type), weight_bits,
+                   channel_wise)
+        if save_model_dir:
+            from .. import jit
+
+            jit.save(self._model, save_model_dir)
+        return self._model
+
+    def _swap(self, layer, types, bits, channel_wise):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Linear) and "linear" in types:
+                layer._sub_layers[name] = QuantizedInferenceLinear(
+                    sub, None, bits, channel_wise=channel_wise)
+            elif isinstance(sub, Conv2D) and "conv2d" in types:
+                layer._sub_layers[name] = QuantizedInferenceConv2D(
+                    sub, None, bits, channel_wise=channel_wise)
+            else:
+                self._swap(sub, types, bits, channel_wise)

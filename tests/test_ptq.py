@@ -189,3 +189,24 @@ def test_ptq_skips_unobserved_layer_with_warning():
     assert kinds["unused"] == "Linear"        # untouched
     got = np.asarray(qm(x)._value)
     np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.05)
+
+
+def test_weight_only_quantization():
+    """Reference WeightQuantization surface: int8 weights, fp32
+    activations, no calibration pass needed."""
+    from paddle_tpu.quantization import WeightQuantization
+
+    paddle.seed(5)
+    m = paddle.nn.Sequential(paddle.nn.Conv2D(1, 4, 3, padding=1),
+                             paddle.nn.ReLU(),
+                             paddle.nn.Flatten(),
+                             paddle.nn.Linear(4 * 8 * 8, 10))
+    x = Tensor(np.random.RandomState(0).randn(2, 1, 8, 8).astype(np.float32))
+    ref = _np(m(x))
+    qm = WeightQuantization(model=m).quantize_weight_to_int()
+    kinds = [type(s).__name__ for _, s in qm.named_sublayers()]
+    assert "QuantizedInferenceLinear" in kinds
+    assert "QuantizedInferenceConv2D" in kinds
+    got = _np(qm(x))
+    # int8 weights only: outputs stay within quantization error of fp32
+    assert np.abs(got - ref).max() < 0.05 * (np.abs(ref).max() + 1e-6)

@@ -32,9 +32,10 @@ from bench_common import (  # noqa: E402
     retry,
 )
 
-# measured per-chip optima on v5e (b256@s128 OOMs against the AdamW
-# fp32-master/moment state of the 340M model)
-DEFAULT_BATCH = {128: 128, 512: 32}
+# measured per-chip optima on v5e (b256@s128 and b64@s512 OOM against the
+# AdamW fp32-master/moment state of the 340M model; s512: b48 42.4k > b32
+# 40.6k tok/s)
+DEFAULT_BATCH = {128: 128, 512: 48}
 
 
 def _run_one(seq, batch=None, iters=None):

@@ -19,6 +19,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--model", default="gpt", choices=["gpt", "bert"])
     a = ap.parse_args()
 
     import jax
@@ -28,12 +29,20 @@ def main():
     from paddle_tpu.jit.functionalize import CompiledStep
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                    num_heads=12, max_position_embeddings=a.seq,
-                    hidden_dropout=0.0, attention_dropout=0.0)
     batch, seq = a.batch, a.seq
     paddle.seed(0)
-    model = GPTForCausalLM(cfg)
+    if a.model == "bert":
+        from paddle_tpu.models import BertForPretraining, bert_large
+
+        cfg = bert_large()
+        cfg.hidden_dropout = 0.0
+        cfg.attention_dropout = 0.0
+        model = BertForPretraining(cfg)
+    else:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=a.seq,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+        model = GPTForCausalLM(cfg)
     model.to(dtype="bfloat16")
     for name, sub in model.named_sublayers():
         if type(sub).__name__ == "LayerNorm":
