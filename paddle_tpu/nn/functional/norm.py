@@ -93,6 +93,11 @@ def _bn_infer_raw(x, rm, rv, weight, bias, epsilon=1e-5, axis=1):
 
 @op("batch_norm_train")
 def _bn_train_raw(x, weight, bias, epsilon=1e-5, axis=1):
+    # fp32 statistics via one explicit upcast. Alternatives measured on
+    # ResNet-50 b128/v5e: per-consumer inline casts with the E[x^2]-E[x]^2
+    # variance collapsed throughput 14x (XLA fusion cliff), so the shared
+    # xf copy stays — its convert_reduce cost (~38% of a BN-heavy step) is
+    # the price of usable bf16 BN gradients.
     axes = tuple(i for i in range(x.ndim) if i != axis)
     f32 = jnp.float32
     xf = x.astype(f32)

@@ -19,7 +19,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--batch", type=int, default=16)
-    ap.add_argument("--model", default="gpt", choices=["gpt", "bert"])
+    ap.add_argument("--model", default="gpt", choices=["gpt", "bert", "resnet"])
     a = ap.parse_args()
 
     import jax
@@ -31,7 +31,26 @@ def main():
 
     batch, seq = a.batch, a.seq
     paddle.seed(0)
-    if a.model == "bert":
+    if a.model == "resnet":
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.models import resnet50
+
+        paddle.incubate.autotune.set_config({"layout": {"enable": True}})
+        cfg = None
+        model = resnet50(num_classes=1000)
+
+        class _M:
+            def loss(self, x, y):
+                logits = model(x)
+                return F.cross_entropy(logits.astype("float32"), y,
+                                       reduction="mean")
+
+            to = model.to
+            named_sublayers = model.named_sublayers
+            parameters = model.parameters
+
+        model_wrap = _M()
+    elif a.model == "bert":
         from paddle_tpu.models import BertForPretraining, bert_large
 
         cfg = bert_large()
@@ -45,14 +64,17 @@ def main():
         model = GPTForCausalLM(cfg)
     model.to(dtype="bfloat16")
     for name, sub in model.named_sublayers():
-        if type(sub).__name__ == "LayerNorm":
+        if (type(sub).__name__ == "LayerNorm"
+                or type(sub).__name__.startswith("BatchNorm")):
             sub.to(dtype="float32")
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters(),
                                  multi_precision=True)
 
+    loss_model = model_wrap if a.model == "resnet" else model
+
     def full_step(ids, labels):
-        loss = model.loss(ids, labels)
+        loss = loss_model.loss(ids, labels)
         loss.backward()
         opt.step()
         opt.clear_grad()
@@ -60,14 +82,26 @@ def main():
 
     step = CompiledStep(full_step, stateful=[model, opt], donate_state=True)
     rng = np.random.RandomState(0)
-    data = [Tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
-            for _ in range(8)]
+    if a.model == "resnet":
+        import jax.numpy as jnp
+
+        data = [(Tensor(jnp.asarray(rng.randn(batch, 3, 224, 224)
+                                    .astype(np.float32)).astype("bfloat16")),
+                 Tensor(rng.randint(0, 1000, (batch, 1)).astype(np.int64)))
+                for _ in range(8)]
+    else:
+        data = [Tensor(rng.randint(0, cfg.vocab_size,
+                                   (batch, seq)).astype(np.int64))
+                for _ in range(8)]
+    def _args(d):
+        return d if isinstance(d, tuple) else (d, d)
+
     for i in range(3):
-        np.asarray(step(data[i], data[i])._value)
+        np.asarray(step(*_args(data[i]))._value)
 
     d = tempfile.mkdtemp(prefix="xplane_")
     with jax.profiler.trace(d):
-        outs = [step(data[3 + i], data[3 + i]) for i in range(4)]
+        outs = [step(*_args(data[3 + i])) for i in range(4)]
         np.asarray(outs[-1]._value)
 
     time.sleep(2)

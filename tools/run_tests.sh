@@ -20,15 +20,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# the sweep files re-check every op-table entry (fp32 FD + bf16/fp16) and
-# the launch/elastic files spawn real 2-process jobs — together they are
-# the bulk of suite wall-time
+# the sweep files re-check every op-table entry (fp32 FD + bf16/fp16),
+# the launch/elastic files spawn real 2-process jobs, and the deep
+# parallelism files (ring attention / 1F1B pipeline / per-tick RNG) carry
+# the heaviest mesh compiles — together they are the bulk of wall-time
+# (measured --durations=25: sequence_parallel ~194 s, pipeline ~104 s)
 SLOW_FILES=(
   tests/test_op_grad_sweep.py
   tests/test_op_grad_sweep_lowp.py
   tests/test_static_parity_sweep.py
   tests/test_launch_multiprocess.py
   tests/test_native_core.py
+  tests/test_sequence_parallel.py
+  tests/test_pipeline_schedule.py
+  tests/test_rng_dropout.py
 )
 
 MODE="full"
