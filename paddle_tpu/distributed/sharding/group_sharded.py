@@ -58,19 +58,14 @@ def _axis_sharding(group, ndim, shape, offload=False):
             # backend without a host memory space: the offload REQUEST is
             # not honorable — say so once instead of silently reporting
             # device placement as success (round-5 VERDICT weak #5)
-            import warnings
+            from ...utils import warn_once
 
-            global _warned_offload
-            if not _warned_offload:
-                _warned_offload = True
-                warnings.warn(
-                    "group_sharded offload=True: this backend exposes no "
-                    "pinned_host memory space; optimizer state stays in "
-                    "device memory (sharded, but NOT offloaded)")
+            warn_once(
+                "group_sharded_offload",
+                "group_sharded offload=True: this backend exposes no "
+                "pinned_host memory space; optimizer state stays in "
+                "device memory (sharded, but NOT offloaded)")
     return sh
-
-
-_warned_offload = False
 
 
 def _shard_value(v, group, offload=False):
@@ -181,9 +176,17 @@ def group_sharded_parallel(
     if level == "p_g_os":
         model = ShardedLayer(model, g)
     else:
-        # params replicated over the sharding axis (classic DP postcondition)
+        # params replicated over the sharding axis (classic DP
+        # postcondition) — but NEVER clobber a parameter that already
+        # carries a deliberate placement on this mesh (e.g. the planner's
+        # tensor-parallel 'mp' shardings): ZeRO over the data axis composes
+        # with TP, and re-replicating would silently undo it
         repl = NamedSharding(g.mesh, P())
         for p in model.parameters(include_sublayers=True):
+            sh = getattr(p._value, "sharding", None)
+            if (isinstance(sh, NamedSharding)
+                    and sh.mesh.shape == g.mesh.shape and sh.spec != P()):
+                continue
             p._value = jax.device_put(p._value, repl)
     if level in ("os_g", "p_g_os"):
         # stage-2/3: shard gradients the moment backward deposits them

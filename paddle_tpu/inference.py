@@ -17,19 +17,12 @@ no TPU/XLA meaning (``enable_use_gpu``, ``switch_ir_optim(False)``,
 """
 from __future__ import annotations
 
-import warnings
-
 import numpy as np
 
+from .utils import _WARNED_ONCE as _WARNED  # noqa: F401 (test reset hook)
+from .utils import warn_once as _warn_once
+
 __all__ = ["Config", "Predictor", "create_predictor"]
-
-_WARNED = set()
-
-
-def _warn_once(key, msg):
-    if key not in _WARNED:
-        _WARNED.add(key)
-        warnings.warn(msg, stacklevel=3)
 
 
 class Config:

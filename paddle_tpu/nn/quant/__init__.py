@@ -140,7 +140,9 @@ class QuantizedConv2D(Layer):
                         stride=self.inner._stride,
                         padding=self.inner._padding,
                         dilation=self.inner._dilation,
-                        groups=self.inner._groups)
+                        groups=self.inner._groups,
+                        data_format=getattr(self.inner, "_data_format",
+                                            "NCHW"))
 
 
 def quant_aware(model, weight_bits=8, activation_bits=8, moving_rate=0.9):

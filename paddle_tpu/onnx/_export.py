@@ -171,6 +171,9 @@ def _emit_op(e, node):
         ]
         if kw.get("ceil_mode"):
             attrs.append(_attr("ceil_mode", _AT_INT, 1))
+        if op == "avg_pool_nd" and not kw.get("exclusive", True):
+            # paddle exclusive=False divides by the FULL window incl. pads
+            attrs.append(_attr("count_include_pad", _AT_INT, 1))
         e.add("MaxPool" if op == "max_pool_nd" else "AveragePool",
               ins, outs, attrs)
     elif op == "adaptive_avg_pool_nd":
@@ -181,17 +184,40 @@ def _emit_op(e, node):
                 "onnx export: adaptive pool with output_size != 1")
         e.add("GlobalAveragePool", ins, outs)
     elif op == "flatten":
-        if kw.get("stop_axis", -1) != -1:
-            raise NotImplementedError("onnx export: partial flatten")
-        e.add("Flatten", ins, outs,
-              [_attr("axis", _AT_INT, kw.get("start_axis", 1))])
+        start = kw.get("start_axis", 0)
+        stop = kw.get("stop_axis", -1)
+        if start == 1 and stop == -1:
+            # exact ONNX Flatten semantics (output [d0, prod(rest)])
+            e.add("Flatten", ins, outs, [_attr("axis", _AT_INT, 1)])
+        elif start >= 1:
+            # general paddle flatten keeps dims < start: emit Reshape to
+            # the traced output shape with dim0 symbolic (batch)
+            out_shape = [-1] + [int(d) for d in node.outs[0].shape[1:]]
+            shape = e.init(np.asarray(out_shape, np.int64), "shape")
+            e.add("Reshape", [ins[0], shape], outs)
+        else:
+            raise NotImplementedError(
+                "onnx export: flatten(start_axis=0) folds the batch dim "
+                "and cannot stay batch-polymorphic")
     elif op == "linear":
         x, w, b = (ins + [""])[:3]
-        # paddle weight is [in, out]: Gemm(transB=0) consumes it directly
-        e.add("Gemm", [x, w] + ([b] if b else []), outs,
-              [_attr("alpha", _AT_FLOAT, 1.0),
-               _attr("beta", _AT_FLOAT, 1.0),
-               _attr("transB", _AT_INT, 0)])
+        x_rank = len(node.args[0].shape) if hasattr(node.args[0], "shape") \
+            else 2
+        if x_rank == 2:
+            # paddle weight is [in, out]: Gemm(transB=0) consumes it as-is
+            e.add("Gemm", [x, w] + ([b] if b else []), outs,
+                  [_attr("alpha", _AT_FLOAT, 1.0),
+                   _attr("beta", _AT_FLOAT, 1.0),
+                   _attr("transB", _AT_INT, 0)])
+        else:
+            # ONNX Gemm is rank-2 only: higher-rank inputs broadcast
+            # through MatMul (+ Add for the bias)
+            if b:
+                mm = e.name("matmul_out")
+                e.add("MatMul", [x, w], [mm])
+                e.add("Add", [mm, b], outs)
+            else:
+                e.add("MatMul", [x, w], outs)
     elif op == "matmul":
         if kw.get("transpose_x") or kw.get("transpose_y"):
             raise NotImplementedError("onnx export: transposed matmul")
@@ -236,16 +262,26 @@ def export_program(program, inputs, outputs, path, producer="paddle_tpu"):
     for node in program.ops:
         _emit_op(e, node)
 
+    def _elem(v):
+        dt = str(getattr(v, "dtype", "float32"))
+        if "int64" in dt:
+            return _I64
+        if "int32" in dt:
+            return 6
+        if "bool" in dt:
+            return 9
+        return _F32
+
     graph = b"".join(P.emit_msg(1, n) for n in e.nodes)
     graph += P.emit_bytes(2, "paddle_tpu_graph")
     for name, arr in e.initializers.items():
         graph += P.emit_msg(5, _tensor(name, arr))
     for v in inputs:
         shape = [None] + list(v.shape)[1:]  # dim0 exported symbolic
-        graph += P.emit_msg(11, _value_info(v.name, shape))
+        graph += P.emit_msg(11, _value_info(v.name, shape, _elem(v)))
     for v in outputs:
         graph += P.emit_msg(12, _value_info(
-            v.name, [None] + list(v.shape)[1:]))
+            v.name, [None] + list(v.shape)[1:], _elem(v)))
 
     opset = P.emit_bytes(1, "") + P.emit_int(2, _OPSET)
     model = (P.emit_int(1, 8)                      # ir_version

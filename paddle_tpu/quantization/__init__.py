@@ -225,6 +225,7 @@ class QuantizedInferenceConv2D(Layer):
         self._padding = layer._padding
         self._dilation = layer._dilation
         self._groups = layer._groups
+        self._data_format = getattr(layer, "_data_format", "NCHW")
 
     def forward(self, x):
         from ..nn import functional as F
@@ -236,7 +237,8 @@ class QuantizedInferenceConv2D(Layer):
              * self.weight_scale._value)
         return F.conv2d(Tensor(xv), Tensor(w), self.bias,
                         stride=self._stride, padding=self._padding,
-                        dilation=self._dilation, groups=self._groups)
+                        dilation=self._dilation, groups=self._groups,
+                        data_format=self._data_format)
 
 
 # ---------------------------------------------------------------------------

@@ -83,3 +83,17 @@ def try_import(module_name, err_msg=None):
     except ImportError:
         raise ImportError(
             err_msg or f"Failed to import {module_name!r}; install it first.")
+
+
+_WARNED_ONCE = set()
+
+
+def warn_once(key, msg, stacklevel=3):
+    """Emit ``msg`` as a UserWarning at most once per process for ``key``
+    (shared one-shot-warning helper for accepted-but-inert knobs and
+    degraded fallbacks — inference Config, ZeRO offload, PTQ skips)."""
+    if key not in _WARNED_ONCE:
+        _WARNED_ONCE.add(key)
+        import warnings
+
+        warnings.warn(msg, stacklevel=stacklevel)

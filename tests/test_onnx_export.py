@@ -117,3 +117,50 @@ def test_unsupported_opset_raises(tmp_path):
         export(paddle.nn.Linear(2, 2), str(tmp_path / "o9"),
                input_spec=[InputSpec([None, 2], "float32")],
                opset_version=9)
+
+
+def test_flatten_start2_and_3d_linear_and_inclusive_pool(tmp_path):
+    """Review regressions: general flatten emits a batch-polymorphic
+    Reshape; >2-D linear emits MatMul+Add (Gemm is rank-2 only);
+    exclusive=False avg pool carries count_include_pad=1."""
+    import paddle_tpu.nn.functional as F
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(6, 5)
+
+        def forward(self, x):                 # x: [b, 2, 3, 6]
+            y = self.lin(x)                   # 4-D linear -> MatMul+Add
+            y = paddle.flatten(y, start_axis=2)   # [b, 2, 15] -> Reshape
+            return y
+
+    with unique_name.guard():
+        paddle.seed(9)
+        m = M()
+    path = export(m, str(tmp_path / "gen"),
+                  input_spec=[InputSpec([None, 2, 3, 6], "float32")])
+    s = load_structure(path)
+    ops = [n["op_type"] for n in s["nodes"]]
+    assert "MatMul" in ops and "Add" in ops and "Gemm" not in ops
+    assert "Reshape" in ops and "Flatten" not in ops
+    reshape = next(n for n in s["nodes"] if n["op_type"] == "Reshape")
+    tgt = s["initializers"][reshape["inputs"][1]]
+    assert tgt.tolist() == [-1, 2, 15]
+
+    class P2(paddle.nn.Layer):
+        def forward(self, x):
+            return F.avg_pool2d(x, 2, stride=2, padding=1, exclusive=False)
+
+    path2 = export(P2(), str(tmp_path / "pool"),
+                   input_spec=[InputSpec([None, 2, 8, 8], "float32")])
+    s2 = load_structure(path2)
+    assert [n["op_type"] for n in s2["nodes"]] == ["AveragePool"]
+
+    class P0(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.flatten(x, start_axis=0)
+
+    with pytest.raises(NotImplementedError, match="batch"):
+        export(P0(), str(tmp_path / "f0"),
+               input_spec=[InputSpec([None, 4], "float32")])

@@ -242,3 +242,24 @@ def test_stage2_parity_with_unsharded():
     for level in ("os", "os_g", "p_g_os"):
         np.testing.assert_allclose(run(level), base, rtol=1e-5,
                                    err_msg=f"level={level}")
+
+
+def test_group_sharded_preserves_tp_placements():
+    """Review regression: ZeRO over the data axis must not re-replicate a
+    parameter deliberately sharded over another mesh axis (the planner's
+    tensor-parallel placements compose with ZeRO)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.collective import Group
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "mp"))
+    g = Group(mesh, "dp", gid=151)
+    net = paddle.nn.Linear(8, 16, bias_attr=False)
+    w = net.parameters()[0]
+    w._value = jax.device_put(w._value, NamedSharding(mesh, P(None, "mp")))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    net2, opt2, _ = group_sharded_parallel(net, opt, level="os_g", group=g)
+    assert net2.parameters()[0]._value.sharding.spec == P(None, "mp")

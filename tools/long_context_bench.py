@@ -79,11 +79,19 @@ def main():
         last = float(np.asarray(outs[-1]._value))
         dt = (time.perf_counter() - t0) / n
         toks = batch * seq / dt
-        # attention share grows with s: flops/token = 6*N_mat + 12*L*H*s
+        # attention share grows with s: flops/token = 6*N_mat + 12*L*H*s;
+        # MFU only against a KNOWN chip peak (tools/bench_common.py policy)
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_common import device_peak
+
         n_mat = cfg.num_layers * 12 * cfg.hidden_size ** 2 \
             + cfg.vocab_size * cfg.hidden_size
         fpt = 6 * n_mat + 12 * cfg.num_layers * cfg.hidden_size * seq
-        mfu = toks * fpt / 197e12 if on_tpu else float("nan")
+        _, peak = device_peak()
+        mfu = toks * fpt / peak if (on_tpu and peak) else float("nan")
         assert np.isfinite(last)
         print(f"seq={seq:6d} batch={batch:3d}: {dt * 1e3:8.1f} ms/step "
               f"{toks:9.0f} tok/s  mfu={mfu:.3f}  loss={last:.3f}",
