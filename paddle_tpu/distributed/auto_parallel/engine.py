@@ -77,9 +77,11 @@ class Engine:
         and enable ZeRO via the strategy when the plan says so. pp is not
         auto-applied (pipelining needs the fleet build path); dp and
         sharding are searched exclusively because this applier realizes
-        ZeRO over the whole data axis. Falls back to the legacy replicated/
-        dp behavior when no factorization satisfies the model's
-        constraints."""
+        ZeRO over the whole data axis. ANY planner-stage failure degrades
+        to the legacy replicated/dp behavior — planning is an optimization
+        and must never crash ``fit``."""
+        import warnings
+
         self._auto_plan_pending = False
         from .planner import Planner, stats_from_forward
 
@@ -91,38 +93,50 @@ class Engine:
             loss = loss_fn(out, Tensor(ya))
             return loss._value if isinstance(loss, Tensor) else loss
 
-        batch = int(np.asarray(x._value).shape[0]) if x._value.ndim else 0
-        stats = stats_from_forward(
-            fwd_loss, (np.asarray(x._value), np.asarray(y._value)),
-            model, batch=batch)
-        stats["layers"] = 1  # generic models: no auto-pipelining
-        planner = Planner(n, stats, exclusive_data_axis=True)
+        # the cost-model trace runs under jax.jit: train-mode layers that
+        # write buffers (BatchNorm running stats) would capture tracers in
+        # model state (UnexpectedTracerError on the next real step) — trace
+        # in eval() mode and snapshot/restore the buffers regardless
+        was_training = getattr(model, "training", True)
+        buf_snapshot = [(b, b._value) for b in model.buffers()
+                        if b is not None]
+        old_pm = self._pm
         try:
+            model.eval()
+            batch = int(np.asarray(x._value).shape[0]) if x._value.ndim else 0
+            stats = stats_from_forward(
+                fwd_loss, (np.asarray(x._value), np.asarray(y._value)),
+                model, batch=batch)
+            stats["layers"] = 1  # generic models: no auto-pipelining
+            planner = Planner(n, stats, exclusive_data_axis=True)
             plan = planner.plan()
-        except ValueError as e:
-            import warnings
 
+            data_ways = plan.dp * plan.sharding
+            self._pm = ProcessMesh(np.arange(n).reshape(data_ways, plan.mp),
+                                   dim_names=["dp", "mp"])
+            if plan.mp > 1:
+                placements = planner.param_placements(
+                    [(name, tuple(p.shape))
+                     for name, p in model.named_parameters()], plan)
+                mesh = self._pm.jax_mesh
+                for name, p in model.named_parameters():
+                    spec = placements.get(name)
+                    if spec and any(s is not None for s in spec):
+                        p._value = jax.device_put(
+                            p._value, NamedSharding(mesh, P(*spec)))
+            if plan.sharding > 1:
+                self.strategy = plan.to_strategy()  # _apply_strategy adds ZeRO
+            self.plan_ = plan
+        except Exception as e:
+            self._pm = old_pm
             warnings.warn(
-                f"auto-parallel planner found no applicable plan "
-                f"({e}); keeping the default data-parallel placement")
-            return
-        self.plan_ = plan
-
-        data_ways = plan.dp * plan.sharding
-        self._pm = ProcessMesh(np.arange(n).reshape(data_ways, plan.mp),
-                               dim_names=["dp", "mp"])
-        if plan.mp > 1:
-            placements = planner.param_placements(
-                [(name, tuple(p.shape))
-                 for name, p in model.named_parameters()], plan)
-            mesh = self._pm.jax_mesh
-            for name, p in model.named_parameters():
-                spec = placements.get(name)
-                if spec and any(s is not None for s in spec):
-                    p._value = jax.device_put(
-                        p._value, NamedSharding(mesh, P(*spec)))
-        if plan.sharding > 1:
-            self.strategy = plan.to_strategy()  # _apply_strategy adds ZeRO
+                f"auto-parallel planner found no applicable plan ({e!r}); "
+                f"keeping the default data-parallel placement")
+        finally:
+            for b, v in buf_snapshot:
+                b._value = v
+            if was_training:
+                model.train()
 
     # -- strategy ------------------------------------------------------------
     def _apply_strategy(self):
@@ -170,15 +184,21 @@ class Engine:
         return max(1, int(strat.gradient_merge_configs.get("k_steps", 1)))
 
     # -- data placement ------------------------------------------------------
-    def _shard_batch(self, arr):
-        arr = np.asarray(arr)
+    def _place_array(self, arr):
+        """Stage one host array onto the mesh: batch dim over the data axis
+        when divisible, replicated otherwise. Also the ``place_fn`` handed
+        to ``io.DeviceLoader`` so batches prefetch straight into their
+        distributed layout."""
         mesh = self._pm.jax_mesh
         dp = mesh.shape[self._pm.dim_names[0]]
         spec = [None] * arr.ndim
         if arr.ndim and arr.shape[0] % dp == 0:
             spec[0] = self._pm.dim_names[0]
         # else: replicate (batch not divisible by the data dim)
-        return Tensor(jax.device_put(arr, NamedSharding(mesh, P(*spec))))
+        return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+    def _shard_batch(self, arr):
+        return Tensor(self._place_array(np.asarray(arr)))
 
     def _replicate_params(self):
         mesh = self._pm.jax_mesh
@@ -228,8 +248,16 @@ class Engine:
                 opt.clear_grad()
                 return loss, out
 
+            # donate_inputs: fit/evaluate only ever feed freshly staged
+            # batches (DeviceLoader or per-step _shard_batch copies), so
+            # their HBM is handed back to XLA for the step's temporaries.
+            # CPU is excluded: donating mesh-sharded inputs races the
+            # forced-host-platform runtime (intermittent SIGSEGV/SIGABRT
+            # under the 8-device test mesh) and buys nothing there anyway.
+            donate_in = jax.default_backend() != "cpu"
             self._train_step = CompiledStep(step, stateful=[model, opt],
-                                            donate_state=True)
+                                            donate_state=True,
+                                            donate_inputs=donate_in)
         return self._train_step
 
     def _ensure_eval(self):
@@ -249,33 +277,67 @@ class Engine:
 
     # -- public API (reference engine.py fit/evaluate/predict) ---------------
     def fit(self, train_data, batch_size=1, epochs=1, steps_per_epoch=None,
-            verbose=0, collate_fn=None):
+            verbose=0, collate_fn=None, prefetch=2, log_freq=10):
+        """Train over ``train_data``. ``prefetch`` batches stage host→device
+        behind a background thread (``io.DeviceLoader``, sharded over the
+        mesh's data axis); per-step losses stay on device and fence only
+        every ``log_freq`` steps + at epoch end. ``prefetch=0`` restores
+        the synchronous per-step path (debugging aid)."""
+        import itertools
+
         from ...io import DataLoader
+        from ...io.device_loader import DeviceLoader
+        from ...metric import AsyncMetricBuffer
 
         loader = (train_data if isinstance(train_data, DataLoader)
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=True, drop_last=True,
                                   collate_fn=collate_fn))
         step = None
-        history = []
+        buf = AsyncMetricBuffer()
+        log_freq = max(1, int(log_freq or 1))
         for epoch in range(epochs):
-            for i, batch in enumerate(loader):
-                if steps_per_epoch is not None and i >= steps_per_epoch:
+            it = iter(loader)
+            if step is None:
+                # the first batch drives auto-planning (which may reshape
+                # the mesh), so it must be consumed BEFORE the prefetcher
+                # starts staging onto that mesh
+                try:
+                    first = next(it)
+                except StopIteration:
                     break
-                x, y = batch[0], batch[1]
-                if step is None:
-                    if self._auto_plan_pending:
-                        self._auto_plan(x, y)
-                    step = self._ensure_train()
-                loss, out = step(self._shard_batch(np.asarray(x._value)),
-                                 self._shard_batch(np.asarray(y._value)))
-                history.append(float(np.asarray(loss._value)))
-                if verbose and i % 10 == 0:
-                    print(f"epoch {epoch} step {i}: loss {history[-1]:.4f}")
-        return {"loss": history}
+                if self._auto_plan_pending:
+                    self._auto_plan(first[0], first[1])
+                step = self._ensure_train()
+                it = itertools.chain([first], it)
+            if prefetch:
+                it = iter(DeviceLoader(it, buffer_size=prefetch,
+                                       place_fn=self._place_array))
+            try:
+                for i, batch in enumerate(it):
+                    if steps_per_epoch is not None and i >= steps_per_epoch:
+                        break
+                    x, y = batch[0], batch[1]
+                    if not prefetch:
+                        x = self._shard_batch(np.asarray(x._value))
+                        y = self._shard_batch(np.asarray(y._value))
+                    loss, out = step(x, y)
+                    buf.append(loss)
+                    if (i + 1) % log_freq == 0:
+                        buf.drain()
+                        if verbose:
+                            print(f"epoch {epoch} step {i}: "
+                                  f"loss {buf.last():.4f}")
+            finally:
+                if hasattr(it, "close"):
+                    it.close()  # stop the stager on early break
+            buf.drain()  # epoch-end fence
+        return {"loss": buf.result()}
 
-    def evaluate(self, valid_data, batch_size=1, collate_fn=None):
+    def evaluate(self, valid_data, batch_size=1, collate_fn=None, prefetch=2):
         from ...io import DataLoader
+        from ...io.device_loader import DeviceLoader
+        from ...metric import AsyncMetricBuffer
 
         loader = (valid_data if isinstance(valid_data, DataLoader)
                   else DataLoader(valid_data, batch_size=batch_size,
@@ -283,17 +345,24 @@ class Engine:
         step = self._ensure_eval()
         for m in self._metrics:
             m.reset()
-        losses = []
-        for batch in loader:
+        buf = AsyncMetricBuffer()
+        src = (DeviceLoader(loader, buffer_size=prefetch,
+                            place_fn=self._place_array)
+               if prefetch else loader)
+        for batch in src:
             x, y = batch[0], batch[1]
-            loss, out = step(self._shard_batch(np.asarray(x._value)),
-                             self._shard_batch(np.asarray(y._value)))
-            losses.append(float(np.asarray(loss._value)))
+            if not prefetch:
+                x = self._shard_batch(np.asarray(x._value))
+                y = self._shard_batch(np.asarray(y._value))
+            loss, out = step(x, y)
+            buf.append(loss)
             for m in self._metrics:
                 if isinstance(m, Metric):
+                    # numpy metric state: this forces the per-step sync
                     state = m.compute(out, Tensor(np.asarray(y._value)))
                     m.update(*[np.asarray(s._value) if isinstance(s, Tensor)
                                else s for s in _to_list(state)])
+        losses = buf.result()  # single fence for the whole eval pass
         logs = {"loss": float(np.mean(losses)) if losses else None}
         for m in self._metrics:
             logs[m.name() if isinstance(m.name(), str) else m.name()[0]] = \

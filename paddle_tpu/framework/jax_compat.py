@@ -1,0 +1,78 @@
+"""Version shims over the jax surface this framework targets.
+
+The codebase is written against the current jax API; older runtimes (the
+0.4.x line still ships on some pool hosts) keep a few of those entry
+points under ``jax.experimental``. Each shim is applied onto the ``jax``
+module itself so call sites — including test modules that do
+``from jax import shard_map`` before importing paddle_tpu — see one
+uniform surface. Idempotent; applied from ``paddle_tpu/__init__`` and
+``tests/conftest.py``.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["ensure_jax_compat"]
+
+
+def _shard_map_adapter(sm_experimental):
+    """jax.experimental.shard_map differs from the stable API in two knobs:
+    the replication check is ``check_rep`` (stable: ``check_vma``), and
+    partial-manual mode takes ``auto=`` — the axes LEFT automatic — where
+    the stable API takes ``axis_names=`` — the axes MADE manual. Translate
+    both (``auto`` = mesh axes minus ``axis_names``)."""
+
+    @functools.wraps(sm_experimental)
+    def shard_map(f, *args, mesh=None, check_vma=None, check_rep=None,
+                  axis_names=None, **kwargs):
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        if axis_names is not None and "auto" not in kwargs:
+            src = mesh if mesh is not None else (args[0] if args else None)
+            kwargs["auto"] = frozenset(src.axis_names) - frozenset(axis_names)
+        if kwargs.get("auto"):
+            # the 0.4.x partial-manual mode predates the varying-type system
+            # and only supports the unchecked path — and only under jit
+            # (the eager impl raises NotImplementedError), so compile it
+            import jax
+
+            check_rep = False
+            if mesh is not None:
+                kwargs["mesh"] = mesh
+            return jax.jit(
+                sm_experimental(f, *args, check_rep=check_rep, **kwargs))
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        return sm_experimental(f, *args, check_rep=check_rep, **kwargs)
+
+    return shard_map
+
+
+def ensure_jax_compat():
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        jax.shard_map = _shard_map_adapter(_sm)
+    if not hasattr(jax, "export"):
+        # the submodule exists but isn't lazily bound on attribute access
+        # in the 0.4.x line — importing it binds jax.export
+        import jax.export  # noqa: F401
+    if not hasattr(jax.sharding, "use_abstract_mesh"):
+        # stable spellings of the ambient-abstract-mesh context; the 0.4.x
+        # implementations live in jax._src.mesh under their old names
+        from jax._src import mesh as _mesh_src
+
+        jax.sharding.use_abstract_mesh = _mesh_src.set_abstract_mesh
+        jax.sharding.get_abstract_mesh = _mesh_src.get_abstract_mesh
+    if not hasattr(jax.lax, "axis_size"):
+        # lax.axis_size(name) predates 0.5; psum of a unit literal is the
+        # classic spelling and folds to a constant at trace time
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+    if not hasattr(jax.lax, "pcast"):
+        # lax.pcast adjusts the varying-type of a value under the new
+        # check_vma system; the 0.4.x shard_map has no varying types (we
+        # run those regions with check_rep=False), so it's an identity
+        jax.lax.pcast = lambda x, axis_name=None, *, to=None: x
+    return jax

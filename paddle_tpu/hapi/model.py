@@ -9,6 +9,15 @@ Here there is one adapter: the dygraph-style train/eval functions are
 functionalized by ``jit.CompiledStep`` into cached XLA executables — the
 dygraph API *is* the static path on TPU. Metrics accumulate host-side
 between steps exactly like the reference's callbacks expect.
+
+Async pipeline (``fit``/``evaluate``): batches are staged host→device
+through ``io.DeviceLoader`` (double-buffered background prefetch) and the
+per-step loss is NOT read back eagerly — device scalars accumulate in a
+``metric.AsyncMetricBuffer`` and the loop fences only every ``log_freq``
+steps and at epoch end, so the device never idles waiting on the host.
+``logs['loss']`` therefore updates at fence boundaries (exactly where
+``ProgBarLogger`` prints). Host-side ``Metric`` objects still synchronize
+every step when present, since their ``compute`` runs in numpy.
 """
 from __future__ import annotations
 
@@ -158,22 +167,31 @@ class Model:
         self._n_inputs_cached = len(ins)
         return ins, labs
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def _train_batch_device(self, inputs, labels=None):
+        """One train step WITHOUT host readback: returns the device-resident
+        loss Tensor and outputs (the async fit loop defers the fence)."""
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer, loss, ...) before training")
         ins, labs = self._split_batch(inputs, labels)
         res = self._ensure_train_step()(*(ins + labs))
-        loss, outs = res[0], res[1:]
-        self._update_metrics(outs, labs)
-        return [float(np.asarray(loss._value))]
+        return res[0], res[1:], labs
 
-    def eval_batch(self, inputs, labels=None):
+    def _eval_batch_device(self, inputs, labels=None):
         ins, labs = self._split_batch(inputs, labels)
         res = self._ensure_eval_step()(*(ins + labs))
         if self._loss is not None:
             loss, outs = res[0], res[1:]
         else:
             loss, outs = None, _to_list(res)
+        return loss, outs, labs
+
+    def train_batch(self, inputs, labels=None, update=True):
+        loss, outs, labs = self._train_batch_device(inputs, labels)
+        self._update_metrics(outs, labs)
+        return [float(np.asarray(loss._value))]
+
+    def eval_batch(self, inputs, labels=None):
+        loss, outs, labs = self._eval_batch_device(inputs, labels)
         self._update_metrics(outs, labs)
         return [float(np.asarray(loss._value))] if loss is not None else []
 
@@ -222,10 +240,11 @@ class Model:
         cbks.on_begin("train")
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(loader, cbks, "train")
+            logs = self._run_one_epoch(loader, cbks, "train", log_freq)
             if eval_loader is not None and epoch % eval_freq == 0:
                 cbks.on_begin("eval")
-                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval",
+                                                log_freq)
                 cbks.on_end("eval", eval_logs)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
@@ -241,7 +260,7 @@ class Model:
                                 verbose=verbose,
                                 metrics=["loss"] + self._metrics_name())
         cbks.on_begin("eval")
-        logs = self._run_one_epoch(loader, cbks, "eval")
+        logs = self._run_one_epoch(loader, cbks, "eval", log_freq)
         cbks.on_end("eval", logs)
         return logs
 
@@ -250,8 +269,10 @@ class Model:
         loader = self._loader(test_data, batch_size, False, num_workers)
         cbks = config_callbacks(callbacks, model=self, verbose=verbose)
         cbks.on_begin("predict")
+        from ..io.device_loader import DeviceLoader
+
         outputs = []
-        for step, batch in enumerate(loader):
+        for step, batch in enumerate(DeviceLoader(loader)):
             batch = _to_list(batch)
             # labeled datasets: drop the trailing label column(s)
             if self._loss is not None and len(batch) >= 2:
@@ -276,12 +297,20 @@ class Model:
             names.extend(n if isinstance(n, (list, tuple)) else [n])
         return names
 
-    def _run_one_epoch(self, loader, cbks, mode):
+    def _run_one_epoch(self, loader, cbks, mode, log_freq=10):
+        from ..io.device_loader import DeviceLoader
+        from ..metric import AsyncMetricBuffer
+
         for m in self._metrics:
             m.reset()
         logs = {}
         total_samples = 0
-        for step, batch in enumerate(loader):
+        # async pipeline: batches stage host->device behind a background
+        # thread; losses stay on device and fence only at log_freq
+        # boundaries + epoch end (metric.AsyncMetricBuffer)
+        buf = AsyncMetricBuffer()
+        log_freq = max(1, int(log_freq or 1))
+        for step, batch in enumerate(DeviceLoader(loader)):
             batch = _to_list(batch)
             # convention: trailing element(s) are labels when a loss is set
             if self._loss is not None and len(batch) >= 2:
@@ -290,19 +319,31 @@ class Model:
                 ins, labs = batch, []
             cbks.on_batch_begin(mode, step, logs)
             if mode == "train":
-                losses = self.train_batch(ins, labs)
-                logs["loss"] = losses[0]
+                loss, outs, labs = self._train_batch_device(ins, labs)
             else:
-                losses = self.eval_batch(ins, labs)
-                if losses:
-                    logs["loss"] = losses[0]
-            for m in self._metrics:
-                res = m.accumulate()
-                for name, v in zip(_to_list(m.name()), _to_list(res)):
-                    logs[name] = v
+                loss, outs, labs = self._eval_batch_device(ins, labs)
+            buf.append(loss)
+            # fence at log_freq boundaries; also once at step 0 so
+            # logs['loss'] exists from the first callback onward (between
+            # fences it holds the last drained value)
+            if step == 0 or (step + 1) % log_freq == 0:
+                buf.drain()  # fence: flush pending device losses to host
+            if buf.values:
+                logs["loss"] = buf.last()
+            if self._metrics:
+                # host-side numpy metrics force a per-step sync; only paid
+                # when the user actually configured metrics
+                self._update_metrics(outs, labs)
+                for m in self._metrics:
+                    res = m.accumulate()
+                    for name, v in zip(_to_list(m.name()), _to_list(res)):
+                        logs[name] = v
             bs = ins[0].shape[0] if hasattr(ins[0], "shape") else len(ins[0])
             total_samples += bs
             cbks.on_batch_end(mode, step, logs)
+        buf.drain()  # epoch-end fence
+        if buf.values:
+            logs["loss"] = buf.last()
         if mode == "eval":
             logs["eval_samples"] = total_samples
         return dict(logs)

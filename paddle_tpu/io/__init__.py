@@ -1,6 +1,8 @@
-"""paddle.io equivalent."""
+"""paddle.io equivalent (plus the TPU-native async staging pipeline:
+``DeviceLoader`` overlaps host→device batch transfer with device compute)."""
 from .collate import default_collate_fn, default_convert_fn  # noqa: F401
 from .dataloader import DataLoader, get_worker_info  # noqa: F401
+from .device_loader import DeviceLoader  # noqa: F401
 from .dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,

@@ -14,6 +14,18 @@ shared-memory tensors. TPU-native redesign:
    ``_DataLoaderIterMultiProcess`` + mmap channel), the right choice for
    Python-heavy per-sample transforms; ``persistent_workers=True`` keeps
    the pool alive across epochs.
+
+Host→device staging is a SEPARATE, composable stage:
+``io.DeviceLoader`` (``io/device_loader.py``) wraps this loader (or any
+batch iterable) and double-buffers ``jax.device_put`` of the next K
+batches behind a background thread, optionally straight into a mesh
+sharding. The train loops (``hapi.Model.fit``, auto-parallel
+``Engine.fit``, the benches) consume the staged iterator so device compute
+never waits on host→device DMA, and pair it with
+``jit.CompiledStep(donate_inputs=True)`` — staged batches are single-use
+and donate their HBM back to the step. Loss readback is likewise deferred
+(``metric.AsyncMetricBuffer``): loops fence only at ``log_freq``
+boundaries and epoch ends.
 """
 from __future__ import annotations
 

@@ -1,0 +1,162 @@
+"""Async host→device staging pipeline (``DeviceLoader``).
+
+The train loops built on :class:`~paddle_tpu.io.DataLoader` produce *host*
+batches (numpy, or Tensors whose arrays live on the default device): left
+alone, the host→device transfer happens implicitly inside the jitted step
+and sits on the device's critical path every iteration. ``DeviceLoader``
+wraps any iterable of batches and stages the next ``buffer_size`` (K ≥ 2,
+double-buffered) batches onto device from a background thread —
+``jax.device_put`` dispatches asynchronously, so by the time the consumer
+asks for batch *i*, its DMA was issued while batch *i-1* was computing.
+
+Back-pressure comes from the bounded hand-off queue: the stager never runs
+more than ``buffer_size`` batches ahead of the consumer, so host RAM and
+device HBM in flight stay bounded. With a mesh/placement active, pass
+``place_fn`` (e.g. a ``NamedSharding`` device_put) and every array leaf is
+staged directly into its distributed layout.
+
+Staged batches are intended to be *consumed*: pair with
+``CompiledStep(donate_inputs=True)`` so each staged batch's HBM is donated
+back to XLA for reuse the moment its step runs.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+import jax
+
+from ..framework.tensor import Tensor
+
+__all__ = ["DeviceLoader"]
+
+_END = object()
+
+
+class _StageError:
+    """Exception captured in the stager thread, re-raised by the consumer."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _default_place(arr):
+    return jax.device_put(arr)
+
+
+class DeviceLoader:
+    """Double-buffered host→device prefetcher over any batch iterable.
+
+    Args:
+        data: iterable of batches — a ``DataLoader``, a list of batch
+            tuples, or a one-shot iterator (re-iterable sources give one
+            epoch per ``iter()`` call; one-shot iterators give one total).
+        buffer_size: number of staged batches the background thread may
+            run ahead of the consumer; clamped to >= 2 (double buffering).
+        place_fn: maps one host array leaf -> device ``jax.Array``.
+            Defaults to ``jax.device_put`` onto the default device; pass a
+            sharded put to stage straight into a mesh layout.
+
+    Batch structure is preserved: array-like leaves (``Tensor``, numpy,
+    ``jax.Array``) are staged, ``Tensor`` leaves stay Tensors, and
+    non-array leaves pass through untouched.
+    """
+
+    def __init__(self, data, buffer_size=2, place_fn=None):
+        self.data = data
+        self.buffer_size = max(2, int(buffer_size))
+        self.place_fn = place_fn or _default_place
+        self._lock = threading.Lock()
+        self._active = []  # live (thread, done-event) pairs, for shutdown()
+
+    def __len__(self):
+        return len(self.data)
+
+    # -- staging -------------------------------------------------------------
+    def _stage_leaf(self, leaf):
+        if isinstance(leaf, Tensor):
+            return Tensor(self.place_fn(leaf._value),
+                          stop_gradient=leaf.stop_gradient)
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+            return self.place_fn(leaf)
+        return leaf
+
+    def _stage(self, batch):
+        # Tensors are opaque to tree_flatten, so they arrive here as leaves
+        return jax.tree_util.tree_map(self._stage_leaf, batch)
+
+    # -- pipeline ------------------------------------------------------------
+    def _put(self, out_q, done, item):
+        while not done.is_set():
+            try:
+                out_q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, it, out_q, done):
+        try:
+            while not done.is_set():
+                try:
+                    batch = next(it)  # source errors propagate to consumer
+                except StopIteration:
+                    break
+                try:
+                    staged = self._stage(batch)
+                except BaseException as e:
+                    self._put(out_q, done, _StageError(e))
+                    return
+                self._put(out_q, done, staged)
+        except BaseException as e:
+            self._put(out_q, done, _StageError(e))
+            return
+        self._put(out_q, done, _END)
+
+    def __iter__(self):
+        out_q: queue.Queue = queue.Queue(maxsize=self.buffer_size)
+        done = threading.Event()
+        t = threading.Thread(target=self._run, args=(iter(self.data), out_q, done),
+                             daemon=True, name="DeviceLoader-stager")
+        entry = (t, done)
+        with self._lock:
+            self._active.append(entry)
+        t.start()
+        try:
+            while True:
+                item = out_q.get()
+                if item is _END:
+                    return
+                if isinstance(item, _StageError):
+                    raise item.exc
+                yield item
+        finally:
+            done.set()
+            try:  # unblock a stager waiting on a full queue
+                out_q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+            with self._lock:
+                if entry in self._active:
+                    self._active.remove(entry)
+
+    def shutdown(self):
+        """Stop all live stager threads (abandoned epoch iterators)."""
+        with self._lock:
+            active, self._active = self._active, []
+        for t, done in active:
+            done.set()
+            t.join(timeout=5.0)
+
+    @property
+    def _live_threads(self):
+        with self._lock:
+            return [t for t, _ in self._active if t.is_alive()]
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -152,11 +152,19 @@ class CompiledStep:
     """A cached compiled XLA step (≙ the reference's compiled-program cache in
     ``fluid/executor.py`` + InterpreterCore instruction list)."""
 
-    def __init__(self, fn, stateful=(), donate_state=True, static_argnames=None):
+    def __init__(self, fn, stateful=(), donate_state=True, donate_inputs=False,
+                 static_argnames=None):
         self.fn = fn
         self.spec = _StateSpec(stateful)
         self._pure = self._build_pure()
         donate = (0,) if donate_state else ()
+        if donate_inputs:
+            # donate the traced batch leaves too: staged single-use batches
+            # (io.DeviceLoader) hand their HBM back to XLA for the step's
+            # own temporaries. Contract: donated inputs are CONSUMED — the
+            # caller must not touch a batch after passing it in.
+            donate = donate + (1,)
+        self.donate_inputs = bool(donate_inputs)
         self._jitted = jax.jit(
             self._pure, donate_argnums=donate, static_argnums=(2,),
             static_argnames=static_argnames
@@ -208,7 +216,8 @@ class CompiledStep:
         return self._jitted.lower(state, dyn, static)
 
 
-def functionalize(fn=None, *, stateful=(), donate_state=True):
+def functionalize(fn=None, *, stateful=(), donate_state=True,
+                  donate_inputs=False):
     """Decorator: compile a dygraph-style step function into one XLA program.
 
         @paddle_tpu.jit.functionalize(stateful=[model, opt])
@@ -218,10 +227,14 @@ def functionalize(fn=None, *, stateful=(), donate_state=True):
             opt.step()
             opt.clear_grad()
             return loss
+
+    ``donate_inputs=True`` additionally donates the batch arrays (see
+    ``CompiledStep``): use with single-use staged batches only.
     """
 
     def deco(f):
-        step = CompiledStep(f, stateful=stateful, donate_state=donate_state)
+        step = CompiledStep(f, stateful=stateful, donate_state=donate_state,
+                            donate_inputs=donate_inputs)
         functools.update_wrapper(step, f, updated=())
         return step
 

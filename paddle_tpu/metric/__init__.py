@@ -4,8 +4,10 @@ from __future__ import annotations
 import numpy as np
 
 from ..framework.tensor import Tensor
+from .async_buffer import AsyncMetricBuffer  # noqa: F401
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy",
+           "AsyncMetricBuffer"]
 
 
 def accuracy(input, label, k=1, correct=None, total=None, name=None):

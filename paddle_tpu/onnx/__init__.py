@@ -10,7 +10,7 @@ whose tape uses ops outside the covered set raise with the op name;
 """
 from __future__ import annotations
 
-__all__ = ["export"]
+__all__ = ["export", "load_structure"]
 
 
 def export(layer, path, input_spec=None, opset_version=13, *,
@@ -70,9 +70,12 @@ def export(layer, path, input_spec=None, opset_version=13, *,
 
 
 def load_structure(path):
-    """Parse an exported ``.onnx`` file back into a structural summary
-    (node op_types/io, initializer names+shapes, graph inputs/outputs) —
-    inspection/testing aid; execution stays with the StableHLO artifact."""
+    """Parse a file produced by :func:`export` (via
+    ``_export.export_program``) back into a structural summary (node
+    op_types/io, initializer names+arrays, graph inputs/outputs) —
+    inspection/testing aid; execution stays with the StableHLO artifact.
+    Initializer element types FLOAT (1), INT32 (6) and INT64 (7) are
+    decoded; anything else raises rather than misreading raw bytes."""
     import numpy as np
 
     from . import _proto as P
@@ -89,14 +92,18 @@ def load_structure(path):
             "outputs": [s.decode() for s in n.get(2, [])],
         })
     inits = {}
+    _elem_np = {1: "<f4", 6: "<i4", 7: "<i8"}
     for raw in graph.get(5, []):
         t = P.parse(raw)
         name = t[8][0].decode()
         dims = tuple(t.get(1, []))
         dt = t[2][0]
         raw_data = t.get(9, [b""])[0]
-        arr = np.frombuffer(
-            raw_data, dtype="<i8" if dt == 7 else "<f4").reshape(dims)
+        if dt not in _elem_np:
+            raise NotImplementedError(
+                f"load_structure: initializer {name!r} has ONNX elem type "
+                f"{dt}, outside the emitted set (FLOAT/INT32/INT64)")
+        arr = np.frombuffer(raw_data, dtype=_elem_np[dt]).reshape(dims)
         inits[name] = arr
 
     def _names(field):

@@ -20,7 +20,7 @@ import numpy as np
 from . import _proto as P
 
 # ONNX TensorProto.DataType
-_F32, _I64 = 1, 7
+_F32, _I32, _I64 = 1, 6, 7
 # AttributeProto.AttributeType
 _AT_FLOAT, _AT_INT, _AT_INTS = 1, 2, 7
 
@@ -55,8 +55,12 @@ def _node(op_type, inputs, outputs, name="", attrs=()):
 
 def _tensor(name, arr):
     arr = np.asarray(arr)
-    if arr.dtype in (np.int64, np.int32):
+    if arr.dtype == np.int64:
         dtype, raw = _I64, arr.astype("<i8").tobytes()
+    elif arr.dtype == np.int32:
+        # keep int32 as elem type 6 / <i4 raw data (upcasting to INT64
+        # would silently change the graph's declared initializer types)
+        dtype, raw = _I32, arr.astype("<i4").tobytes()
     else:
         dtype, raw = _F32, arr.astype("<f4").tobytes()
     body = b"".join(P.emit_int(1, d) for d in arr.shape)

@@ -391,6 +391,24 @@ class WeightQuantization:
                                quantizable_op_type=("conv2d", "linear"),
                                weight_quantize_type="channel_wise_abs_max",
                                generate_test_model=False, threshold_rate=0.0):
+        from ..utils import warn_once
+
+        if threshold_rate:
+            # reference prunes outlier weights beyond the threshold before
+            # quantizing; this implementation quantizes the full range
+            warn_once(
+                "WeightQuantization.threshold_rate",
+                f"quantize_weight_to_int: threshold_rate={threshold_rate} is "
+                f"accepted for API compatibility but ignored — weights are "
+                f"quantized over their full abs-max range")
+        if generate_test_model:
+            # reference also emits a fake-quant test model next to the
+            # int8 artifact; there is no such artifact here
+            warn_once(
+                "WeightQuantization.generate_test_model",
+                "quantize_weight_to_int: generate_test_model=True is "
+                "accepted for API compatibility but ignored — no separate "
+                "test model is produced")
         channel_wise = weight_quantize_type == "channel_wise_abs_max"
         self._swap(self._model, tuple(quantizable_op_type), weight_bits,
                    channel_wise)

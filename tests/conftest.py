@@ -26,6 +26,12 @@ if not _HW:
     jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# normalize the jax surface (jax.shard_map et al.) before test modules run
+# their own `from jax import shard_map` imports — conftest executes first
+from paddle_tpu.framework.jax_compat import ensure_jax_compat
+
+ensure_jax_compat()
+
 # Persistent compilation cache: the eager path compiles one executable per
 # (op, shape) — cache them across tests and across pytest runs. The dir is
 # keyed by the host CPU's feature set: this box's pool mixes machine types,

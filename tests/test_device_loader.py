@@ -1,0 +1,337 @@
+"""Async device pipeline: ``io.DeviceLoader`` staging (ordering,
+back-pressure, shutdown), ``CompiledStep(donate_inputs=True)`` aliasing,
+deferred loss readback equivalence (``metric.AsyncMetricBuffer``) in
+``hapi.Model.fit`` and auto-parallel ``Engine.fit`` on the 8-device CPU
+mesh, and the planner's eval-mode/BN trace regression."""
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.io import DataLoader, DeviceLoader, TensorDataset
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.metric import AsyncMetricBuffer
+
+
+# ---------------------------------------------------------------------------
+# DeviceLoader mechanics
+# ---------------------------------------------------------------------------
+def _batches(n, shape=(4, 3)):
+    rng = np.random.RandomState(0)
+    return [(Tensor(rng.randn(*shape).astype(np.float32)),
+             Tensor(np.full(shape, i, np.float32))) for i in range(n)]
+
+
+def test_device_loader_preserves_order_and_values():
+    data = _batches(12)
+    staged = list(DeviceLoader(data, buffer_size=3))
+    assert len(staged) == 12
+    for i, (x, y) in enumerate(staged):
+        assert isinstance(x, Tensor) and isinstance(y, Tensor)
+        assert isinstance(x._value, jax.Array)
+        np.testing.assert_array_equal(np.asarray(y._value), i)
+        np.testing.assert_array_equal(np.asarray(x._value),
+                                      np.asarray(data[i][0]._value))
+
+
+def test_device_loader_is_reiterable_per_epoch():
+    data = _batches(4)
+    dl = DeviceLoader(data, buffer_size=2)
+    for _ in range(3):  # one staging pass per epoch over a re-iterable source
+        got = [float(np.asarray(y._value[0, 0])) for _, y in dl]
+        assert got == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_device_loader_back_pressure_bounds_prefetch():
+    pulled = []
+    produced = threading.Event()
+
+    def source():
+        for i in range(50):
+            pulled.append(i)
+            produced.set()
+            yield (np.full((2, 2), i, np.float32),)
+
+    dl = DeviceLoader(source(), buffer_size=2)
+    it = iter(dl)
+    next(it)
+    # consumer idles: the stager may run at most buffer_size ahead of the
+    # single consumed batch, plus the one batch in its hands
+    deadline = time.time() + 2.0
+    while time.time() < deadline and len(pulled) < 4:
+        time.sleep(0.02)
+    time.sleep(0.2)  # would overrun well past the bound if unbounded
+    assert 1 <= len(pulled) <= 1 + dl.buffer_size + 1, pulled
+    it.close()
+
+
+def test_device_loader_shutdown_on_early_break():
+    dl = DeviceLoader(_batches(100), buffer_size=2)
+    it = iter(dl)
+    for _ in range(3):
+        next(it)
+    it.close()  # early abandon: the stager thread must terminate
+    deadline = time.time() + 5.0
+    while time.time() < deadline and dl._live_threads:
+        time.sleep(0.02)
+    assert not dl._live_threads
+    dl.shutdown()  # idempotent
+
+
+def test_device_loader_propagates_source_errors():
+    def source():
+        yield (np.ones((2, 2), np.float32),)
+        raise RuntimeError("boom in the loader")
+
+    with pytest.raises(RuntimeError, match="boom in the loader"):
+        list(DeviceLoader(source(), buffer_size=2))
+
+
+def test_device_loader_place_fn_shards_onto_mesh():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def place(arr):
+        spec = [None] * np.ndim(arr)
+        if np.ndim(arr) and np.shape(arr)[0] % 8 == 0:
+            spec[0] = "dp"
+        return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+    data = [(Tensor(np.arange(32, dtype=np.float32).reshape(8, 4)),)]
+    ((x,),) = tuple(DeviceLoader(data, place_fn=place))
+    assert x._value.sharding.spec == P("dp", None)
+    np.testing.assert_array_equal(np.asarray(x._value),
+                                  np.arange(32, dtype=np.float32).reshape(8, 4))
+
+
+def test_device_loader_passes_non_array_leaves():
+    data = [([Tensor(np.ones((2, 2), np.float32)), "tag", 7],)]
+    ((batch,),) = tuple(DeviceLoader(data))
+    assert batch[1] == "tag" and batch[2] == 7
+
+
+# ---------------------------------------------------------------------------
+# donated-input aliasing with CompiledStep
+# ---------------------------------------------------------------------------
+def test_compiled_step_donate_inputs_consumes_staged_batch():
+    # shape-preserving output so XLA can alias the donated input buffer
+    step = CompiledStep(lambda x: x * 2.0, donate_inputs=True)
+    (staged,) = list(DeviceLoader([Tensor(np.ones((64, 64), np.float32))]))
+    out = step(staged)
+    np.testing.assert_array_equal(np.asarray(out._value), 2.0)
+    # the staged batch was CONSUMED: its buffer is gone
+    assert staged._value.is_deleted()
+    with pytest.raises(RuntimeError):
+        np.asarray(staged._value)
+
+
+def test_compiled_step_donate_inputs_off_by_default():
+    step = CompiledStep(lambda x: x * 2.0)
+    x = Tensor(np.ones((8, 8), np.float32))
+    step(x)
+    assert not x._value.is_deleted()
+    np.testing.assert_array_equal(np.asarray(x._value), 1.0)  # still usable
+
+
+def test_donated_training_chain_matches_undonated():
+    """A full train loop over donated staged batches must produce the same
+    losses as the plain per-step path (donation never changes numerics)."""
+
+    def build():
+        paddle.seed(7)
+        net = nn.Linear(6, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=net.parameters())
+
+        def train(x, y):
+            loss = nn.MSELoss()(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return net, opt, train
+
+    rng = np.random.RandomState(3)
+    data = [(rng.randn(8, 6).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32)) for _ in range(6)]
+
+    net, opt, fn = build()
+    step = CompiledStep(fn, stateful=[net, opt], donate_state=True)
+    ref = [float(np.asarray(step(Tensor(x), Tensor(y))._value))
+           for x, y in data]
+
+    net, opt, fn = build()
+    step = CompiledStep(fn, stateful=[net, opt], donate_state=True,
+                        donate_inputs=True)
+    buf = AsyncMetricBuffer()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # CPU may decline some donations
+        for batch in DeviceLoader(data, buffer_size=2):
+            buf.append(step(*batch))
+    assert buf.num_pending == len(data)  # nothing fenced inside the loop
+    assert buf.result() == ref
+
+
+# ---------------------------------------------------------------------------
+# AsyncMetricBuffer
+# ---------------------------------------------------------------------------
+def test_async_metric_buffer_defers_and_orders():
+    buf = AsyncMetricBuffer()
+    vals = [Tensor(np.asarray(float(i))) for i in range(5)]
+    for v in vals[:3]:
+        buf.append(v)
+    assert buf.num_pending == 3 and buf.values == []
+    assert buf.last() is None
+    assert buf.drain() == [0.0, 1.0, 2.0]
+    for v in vals[3:]:
+        buf.append(v)
+    assert buf.result() == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert buf.last() == 4.0
+    assert buf.drain() == []  # idempotent when nothing is pending
+
+
+# ---------------------------------------------------------------------------
+# hapi.Model.fit: deferred readback, fences only at log_freq boundaries
+# ---------------------------------------------------------------------------
+class _ToyRegression:
+    def __init__(self, n=48, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        self.y = rng.randn(n, 1).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _toy_model(lr=0.05):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=lr, parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss())
+    return model
+
+
+def test_fit_fences_only_at_log_freq_boundaries(monkeypatch):
+    """12 steps at log_freq=5: drains happen at step 0 (seed the logs),
+    steps 5 and 10 (boundaries), and epoch end — never in between."""
+    drain_at = []
+    orig_drain = AsyncMetricBuffer.drain
+
+    def counting_drain(self):
+        drain_at.append(len(self.values) + self.num_pending)
+        return orig_drain(self)
+
+    monkeypatch.setattr(AsyncMetricBuffer, "drain", counting_drain)
+    model = _toy_model()
+    model.fit(_ToyRegression(48), batch_size=4, epochs=1, log_freq=5,
+              verbose=0)
+    # drains observed with 1 (step 0), 5, 10 (freq boundaries) and 12
+    # (epoch end) losses issued — i.e. 8 of the 12 steps never synchronized
+    assert drain_at == [1, 5, 10, 12], drain_at
+
+
+def test_fit_deferred_history_matches_eager_train_batch():
+    """Pipelined fit (DeviceLoader + deferred fences) must reproduce the
+    eager per-step float(loss) history bit-exactly."""
+    losses = []
+
+    class Track(paddle.callbacks.Callback):
+        def on_epoch_end(self, epoch, logs=None):
+            losses.append(logs["loss"])
+
+    model = _toy_model()
+    model.fit(_ToyRegression(48), batch_size=4, epochs=1, shuffle=False,
+              verbose=0, callbacks=[Track()])
+
+    ref_model = _toy_model()  # same seed -> identical init
+    loader = DataLoader(_ToyRegression(48), batch_size=4, shuffle=False)
+    ref = [ref_model.train_batch([x], [y])[0] for x, y in loader]
+    assert losses[-1] == ref[-1]
+
+
+def test_evaluate_still_reports_loss_and_metrics():
+    model = _toy_model()
+    model.fit(_ToyRegression(48), batch_size=8, epochs=2, verbose=0)
+    ev = model.evaluate(_ToyRegression(24, seed=1), batch_size=8, verbose=0)
+    assert np.isfinite(ev["loss"])
+    assert ev["eval_samples"] == 24
+
+
+# ---------------------------------------------------------------------------
+# Engine on the 8-device mesh: pipelined history parity + planner regression
+# ---------------------------------------------------------------------------
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+def _engine_fixture(with_bn=False, seed=0):
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+    paddle.seed(seed)
+    layers = [nn.Linear(8, 16)]
+    if with_bn:
+        layers.append(nn.BatchNorm1D(16))
+    layers += [nn.ReLU(), nn.Linear(16, 4)]
+    model = nn.Sequential(*layers)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randn(32, 4).astype(np.float32)
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    return Engine(model=model, loss=nn.MSELoss(), optimizer=opt), ds
+
+
+@needs_mesh
+def test_engine_pipelined_history_matches_synchronous():
+    """Engine.fit with the async pipeline (prefetch+deferred fences) must
+    produce bit-identical per-step losses to the synchronous path."""
+    eng, ds = _engine_fixture()
+    loader = DataLoader(ds, batch_size=8, shuffle=False, drop_last=True)
+    hist = eng.fit(loader, epochs=1, prefetch=2, log_freq=100)["loss"]
+
+    eng2, ds2 = _engine_fixture()
+    loader2 = DataLoader(ds2, batch_size=8, shuffle=False, drop_last=True)
+    ref = eng2.fit(loader2, epochs=1, prefetch=0)["loss"]
+    assert hist == ref
+    assert len(hist) == 4 and all(np.isfinite(v) for v in hist)
+
+
+@needs_mesh
+def test_engine_fit_strategy_none_with_batchnorm_does_not_crash():
+    """Planner regression (ADVICE high): the cost-model trace must run in
+    eval() mode with buffers snapshot/restored — BN running-stat updates
+    under jit left tracers in model state and crashed fit."""
+    eng, ds = _engine_fixture(with_bn=True)
+    assert eng._auto_plan_pending  # strategy=None, no mesh, 8 devices
+    hist = eng.fit(ds, batch_size=8, epochs=1)["loss"]
+    assert len(hist) == 4 and all(np.isfinite(v) for v in hist)
+    # the trace ran under eval(): fit must resume in train mode with clean
+    # (concrete, non-tracer) buffers
+    assert eng.model.training
+    for b in eng.model.buffers():
+        assert isinstance(b._value, jax.Array)
+        assert not isinstance(b._value, jax.core.Tracer)
+
+
+@needs_mesh
+def test_engine_evaluate_defers_readback():
+    eng, ds = _engine_fixture()
+    eng.fit(ds, batch_size=8, epochs=1)
+    logs = eng.evaluate(ds, batch_size=8)
+    assert np.isfinite(logs["loss"])

@@ -164,3 +164,31 @@ def test_flatten_start2_and_3d_linear_and_inclusive_pool(tmp_path):
     with pytest.raises(NotImplementedError, match="batch"):
         export(P0(), str(tmp_path / "f0"),
                input_spec=[InputSpec([None, 4], "float32")])
+
+
+def test_int32_initializer_roundtrips_as_int32(tmp_path):
+    """int32 initializers must emit ONNX elem type 6 with <i4 raw data and
+    parse back as int32 (previously silently upcast to INT64)."""
+    class M(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.reshape(x, shape=[-1, 6])
+
+    with unique_name.guard():
+        m = M()
+    path = export(m, str(tmp_path / "r32"),
+                  input_spec=[InputSpec([None, 2, 3], "float32")])
+    s = load_structure(path)
+    reshape = next(n for n in s["nodes"] if n["op_type"] == "Reshape")
+    shape_init = s["initializers"][reshape["inputs"][1]]
+    assert shape_init.dtype == np.int64  # reshape targets stay int64
+
+    # direct codec check for the int32 lane
+    from paddle_tpu.onnx import _proto as P
+    from paddle_tpu.onnx._export import _tensor
+
+    raw = _tensor("idx", np.asarray([1, 2, 3], np.int32))
+    t = P.parse(raw)
+    assert t[2][0] == 6                      # TensorProto elem type INT32
+    assert t[9][0] == np.asarray([1, 2, 3], "<i4").tobytes()
+    back = np.frombuffer(t[9][0], "<i4")
+    assert back.dtype == np.int32 and back.tolist() == [1, 2, 3]

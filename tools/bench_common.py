@@ -7,6 +7,16 @@ donated state so each step's inputs depend on the previous step's outputs
 (the remote relay's (executable, inputs) result cache can never replay),
 fence on the LAST loss only, fetch the rest after the timer for the
 finiteness check.
+
+Measurement protocol (async-pipeline revision): batches flow through
+``paddle_tpu.io.DeviceLoader`` — a background thread double-buffers the
+host→device transfer of the next ``prefetch`` batches — and per-step losses
+accumulate on device in a ``metric.AsyncMetricBuffer``; the ONLY in-timer
+fence is the final loss. The measured number therefore reflects the
+production input pipeline (prefetch + deferred readback), not a host-bound
+loop. Pass ``prefetch=0`` to ``measure_steps`` for the legacy synchronous
+feed. Steps compiled with ``donate_inputs=True`` consume the staged
+batches — don't reuse a batch list across two measured runs in-process.
 """
 from __future__ import annotations
 
@@ -50,16 +60,30 @@ def retry(run, attempts=3):
     raise last
 
 
-def measure_steps(step, batches, iters, warmup=3):
-    """Run the warmup+steady-state protocol; returns (seconds, losses)."""
-    for i in range(warmup):
-        loss = step(*batches[i])
+def measure_steps(step, batches, iters, warmup=3, prefetch=2):
+    """Run the warmup+steady-state protocol; returns (seconds, losses).
+
+    ``batches`` may be host batches (numpy tuples) or device Tensors; with
+    ``prefetch > 0`` they are staged host→device through ``DeviceLoader``
+    so transfers overlap compute, and losses are read back only after the
+    timer stops (single fence on the last loss inside the timed region).
+    """
+    from paddle_tpu.io import DeviceLoader
+    from paddle_tpu.metric import AsyncMetricBuffer
+
+    feed = iter(DeviceLoader(batches, buffer_size=prefetch)
+                if prefetch else batches)
+    buf = AsyncMetricBuffer()
+    for _ in range(warmup):
+        loss = step(*next(feed))
         np.asarray(loss._value)
     t0 = time.perf_counter()
-    losses = [step(*batches[warmup + i]) for i in range(iters)]
+    losses = [step(*next(feed)) for _ in range(iters)]
     float(np.asarray(losses[-1]._value))  # fence on the dependence chain
     total = time.perf_counter() - t0
-    vals = [float(np.asarray(l._value)) for l in losses]
+    for l in losses:
+        buf.append(l)
+    vals = buf.result()  # post-timer readback for the finiteness check
     assert all(np.isfinite(v) for v in vals), f"bench losses not finite: {vals}"
     return total, vals
 

@@ -47,7 +47,14 @@ for a in "$@"; do
 done
 
 PY=(python -m pytest -q -p no:cacheprovider)
-export PYTHONPATH="/root/.axon_site:$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+# the axon TPU-tunnel site dir only exists on pool hosts; gate it so the
+# runner stays portable (AXON_SITE_DIR overrides the default location)
+AXON_SITE="${AXON_SITE_DIR:-/root/.axon_site}"
+if [[ -d "$AXON_SITE" ]]; then
+  export PYTHONPATH="$AXON_SITE:$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+else
+  export PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+fi
 
 case "$MODE" in
   full)
