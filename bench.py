@@ -28,6 +28,7 @@ from bench_common import (  # noqa: E402
     device_peak,
     measure_steps,
     retry,
+    telemetry_block,
 )
 
 
@@ -103,6 +104,9 @@ def _run():
 
     total, _ = measure_steps(step, batches, iters)
     tokens_per_sec = batch * seq * iters / total
+    # phase attribution for the perf trajectory: steps/s, data-wait
+    # fraction, compile/recompile counts, DeviceLoader prefetch stats
+    telemetry = telemetry_block(total, iters)
 
     # Achieved MFU: standard 6*N_matmul + 12*L*H*s flops/token convention
     # (fwd+bwd; matmul params = decoder blocks + tied head, embedding lookups
@@ -135,6 +139,7 @@ def _run():
         "vs_baseline": round(vs, 3),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "device_kind": kind,
+        "telemetry": telemetry,
     }))
 
 

@@ -7,5 +7,6 @@ from .hapi.callbacks import (  # noqa: F401
     ModelCheckpoint,
     ProgBarLogger,
     ReduceLROnPlateau,
+    TelemetryLogger,
     VisualDL,
 )

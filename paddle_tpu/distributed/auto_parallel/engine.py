@@ -288,6 +288,7 @@ class Engine:
         from ...io import DataLoader
         from ...io.device_loader import DeviceLoader
         from ...metric import AsyncMetricBuffer
+        from ...profiler import telemetry
 
         loader = (train_data if isinstance(train_data, DataLoader)
                   else DataLoader(train_data, batch_size=batch_size,
@@ -296,6 +297,9 @@ class Engine:
         step = None
         buf = AsyncMetricBuffer()
         log_freq = max(1, int(log_freq or 1))
+        # zero-overhead-when-disabled per-step phase timeline (see
+        # hapi.Model._run_one_epoch for the step_begin placement rationale)
+        tm_on = telemetry.enabled()
         for epoch in range(epochs):
             it = iter(loader)
             if step is None:
@@ -313,6 +317,8 @@ class Engine:
             if prefetch:
                 it = iter(DeviceLoader(it, buffer_size=prefetch,
                                        place_fn=self._place_array))
+            if tm_on:
+                telemetry.step_begin()
             try:
                 for i, batch in enumerate(it):
                     if steps_per_epoch is not None and i >= steps_per_epoch:
@@ -328,10 +334,14 @@ class Engine:
                         if verbose:
                             print(f"epoch {epoch} step {i}: "
                                   f"loss {buf.last():.4f}")
+                    if tm_on:
+                        telemetry.step_begin()  # roll the phase record over
             finally:
                 if hasattr(it, "close"):
                     it.close()  # stop the stager on early break
             buf.drain()  # epoch-end fence
+            if tm_on:
+                telemetry.step_end()
         return {"loss": buf.result()}
 
     def evaluate(self, valid_data, batch_size=1, collate_fn=None, prefetch=2):

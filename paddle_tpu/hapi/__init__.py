@@ -1,6 +1,13 @@
 """paddle.hapi — high-level Model API (reference ``python/paddle/hapi/``)."""
 from . import callbacks  # noqa: F401
-from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    TelemetryLogger,
+)
 from .model import Model  # noqa: F401
 from .model_summary import summary  # noqa: F401
 

@@ -17,6 +17,7 @@ __all__ = [
     "LRScheduler",
     "EarlyStopping",
     "VisualDL",
+    "TelemetryLogger",
 ]
 
 
@@ -258,6 +259,76 @@ class VisualDL(Callback):
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+
+
+class TelemetryLogger(Callback):
+    """Turn on the runtime telemetry layer (``profiler.telemetry``) for the
+    run and surface it: pipeline phase scalars (data_wait / h2d_copy /
+    compile / dispatch / readback), DeviceLoader queue stats and the
+    recompile counter stream to a ``LogWriter`` JSONL every ``log_freq``
+    train batches (render with ``tools/telemetry_report.py``), and the
+    phase-breakdown table prints at train end.
+
+    Args:
+        log_dir: JSONL output directory; ``None`` keeps the registry
+            in-memory only (``telemetry.report()`` still works).
+        log_freq: export cadence, in train batches.
+        print_report: print ``telemetry.report()`` on train end.
+        reset_on_begin: clear the registry at train begin so the report
+            covers exactly this run.
+    """
+
+    def __init__(self, log_dir=None, log_freq=10, print_report=True,
+                 reset_on_begin=True):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = max(1, int(log_freq or 1))
+        self.print_report = print_report
+        self.reset_on_begin = reset_on_begin
+        self._writer = None
+        self._train_step = 0
+        self._enabled_here = False
+
+    def _tm(self):
+        from ..profiler import telemetry
+
+        return telemetry
+
+    def _w(self):
+        if self._writer is None and self.log_dir:
+            from ..utils.log_writer import LogWriter
+
+            self._writer = LogWriter(self.log_dir)
+        return self._writer
+
+    def on_train_begin(self, logs=None):
+        telemetry = self._tm()
+        self._train_step = 0
+        if self.reset_on_begin:
+            telemetry.reset()
+        if not telemetry.enabled():
+            telemetry.enable()
+            self._enabled_here = True
+
+    def on_train_batch_end(self, step, logs=None):
+        self._train_step += 1
+        if self.log_dir and self._train_step % self.log_freq == 0:
+            self._tm().get_telemetry().export_scalars(
+                self._w(), step=self._train_step)
+
+    def on_train_end(self, logs=None):
+        telemetry = self._tm()
+        if self.log_dir:
+            telemetry.get_telemetry().export_scalars(
+                self._w(), step=self._train_step)
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        if self.print_report:
+            telemetry.report()
+        if self._enabled_here:
+            telemetry.disable()
+            self._enabled_here = False
 
 
 class EarlyStopping(Callback):

@@ -300,6 +300,7 @@ class Model:
     def _run_one_epoch(self, loader, cbks, mode, log_freq=10):
         from ..io.device_loader import DeviceLoader
         from ..metric import AsyncMetricBuffer
+        from ..profiler import telemetry
 
         for m in self._metrics:
             m.reset()
@@ -310,6 +311,14 @@ class Model:
         # boundaries + epoch end (metric.AsyncMetricBuffer)
         buf = AsyncMetricBuffer()
         log_freq = max(1, int(log_freq or 1))
+        # per-step phase timeline: the flag is global and False by default,
+        # so the disabled path does zero telemetry work. step_begin sits
+        # BEFORE the for statement (and again at each body end) because the
+        # next batch's data_wait happens inside the iterator protocol,
+        # between loop bodies.
+        tm_on = telemetry.enabled()
+        if tm_on:
+            telemetry.step_begin()
         for step, batch in enumerate(DeviceLoader(loader)):
             batch = _to_list(batch)
             # convention: trailing element(s) are labels when a loss is set
@@ -341,7 +350,11 @@ class Model:
             bs = ins[0].shape[0] if hasattr(ins[0], "shape") else len(ins[0])
             total_samples += bs
             cbks.on_batch_end(mode, step, logs)
+            if tm_on:
+                telemetry.step_begin()  # roll the phase record over
         buf.drain()  # epoch-end fence
+        if tm_on:
+            telemetry.step_end()
         if buf.values:
             logs["loss"] = buf.last()
         if mode == "eval":

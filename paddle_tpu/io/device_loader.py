@@ -23,11 +23,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 import jax
 
 from ..framework.tensor import Tensor
+from ..profiler import telemetry as _telemetry
 
 __all__ = ["DeviceLoader"]
 
@@ -43,6 +45,14 @@ class _StageError:
 
 def _default_place(arr):
     return jax.device_put(arr)
+
+
+def _leaf_bytes(leaf):
+    v = getattr(leaf, "_value", leaf)  # Tensor -> backing array
+    try:
+        return int(getattr(v, "nbytes", 0) or 0)
+    except Exception:
+        return 0
 
 
 class DeviceLoader:
@@ -84,7 +94,39 @@ class DeviceLoader:
 
     def _stage(self, batch):
         # Tensors are opaque to tree_flatten, so they arrive here as leaves
-        return jax.tree_util.tree_map(self._stage_leaf, batch)
+        if not _telemetry.enabled():
+            return jax.tree_util.tree_map(self._stage_leaf, batch)
+        t0 = time.perf_counter_ns()
+        staged = jax.tree_util.tree_map(self._stage_leaf, batch)
+        t1 = time.perf_counter_ns()
+        nbytes = sum(_leaf_bytes(l)
+                     for l in jax.tree_util.tree_leaves(batch))
+        tm = _telemetry.get_telemetry()
+        tm.add_phase("h2d_copy", t0, t1)
+        tm.inc("device_loader.batches_staged")
+        tm.inc("device_loader.bytes_staged", nbytes)
+        return staged
+
+    def _instrumented_get(self, out_q):
+        """Telemetry-path queue pop: a prefetch *hit* is a batch already
+        staged (get_nowait succeeds); a *miss* blocks the consumer — that
+        block IS the pipeline's data-wait, accumulated as stall time."""
+        tm = _telemetry.get_telemetry()
+        t0 = time.perf_counter_ns()
+        try:
+            item = out_q.get_nowait()
+            hit = True
+        except queue.Empty:
+            hit = False
+            item = out_q.get()
+        t1 = time.perf_counter_ns()
+        tm.add_phase("data_wait", t0, t1)
+        tm.inc("device_loader.prefetch_hit" if hit
+               else "device_loader.prefetch_miss")
+        if not hit:
+            tm.inc("device_loader.stall_s", (t1 - t0) / 1e9)
+        tm.set_gauge("device_loader.queue_depth", out_q.qsize())
+        return item
 
     # -- pipeline ------------------------------------------------------------
     def _put(self, out_q, done, item):
@@ -125,7 +167,10 @@ class DeviceLoader:
         t.start()
         try:
             while True:
-                item = out_q.get()
+                if _telemetry.enabled():
+                    item = self._instrumented_get(out_q)
+                else:
+                    item = out_q.get()
                 if item is _END:
                     return
                 if isinstance(item, _StageError):

@@ -18,11 +18,13 @@ buckets), and fused-optimizer ops — XLA does the scheduling and fusion.
 from __future__ import annotations
 
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
 
 from ..framework import random as rnd
+from ..profiler import telemetry as _telemetry
 from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..optimizer.optimizer import Optimizer
@@ -155,6 +157,11 @@ class CompiledStep:
     def __init__(self, fn, stateful=(), donate_state=True, donate_inputs=False,
                  static_argnames=None):
         self.fn = fn
+        self.name = getattr(fn, "__name__", type(fn).__name__)
+        # set True by pure() — which only executes while jax traces, i.e.
+        # on a compile-cache miss — so __call__ can attribute its wall time
+        # to the `compile` phase instead of `dispatch`
+        self._trace_marker = {"traced": False}
         self.spec = _StateSpec(stateful)
         self._pure = self._build_pure()
         donate = (0,) if donate_state else ()
@@ -173,8 +180,10 @@ class CompiledStep:
     def _build_pure(self):
         spec = self.spec
         fn = self.fn
+        marker = self._trace_marker
 
         def pure(state, dyn_leaves, static_spec):
+            marker["traced"] = True
             treedef, static_leaves = static_spec
             if static_leaves is None:
                 leaves = list(dyn_leaves)
@@ -202,13 +211,31 @@ class CompiledStep:
         arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
         return _partition_args(arr_args, arr_kwargs)
 
-    def __call__(self, *args, **kwargs):
+    def _invoke(self, args, kwargs):
         state = self.spec.snapshot()
         dyn, static = self._prepare(args, kwargs)
         out_arrays, new_state = self._jitted(state, dyn, static)
         self.spec.install(new_state)
         self.spec.clear_grads()
         return jax.tree_util.tree_map(lambda a: _wrap(a), out_arrays)
+
+    def __call__(self, *args, **kwargs):
+        if not _telemetry.enabled():
+            return self._invoke(args, kwargs)
+        marker = self._trace_marker
+        marker["traced"] = False
+        t0 = time.perf_counter_ns()
+        out = self._invoke(args, kwargs)
+        t1 = time.perf_counter_ns()
+        tm = _telemetry.get_telemetry()
+        if marker["traced"]:
+            # traced this call: wall time is dominated by trace+XLA compile;
+            # repeated hits here for one step name = shape/dtype churn
+            tm.note_compile(self.name, t0, t1)
+        else:
+            # cache hit: host-side enqueue of the async device execution
+            tm.add_phase("dispatch", t0, t1)
+        return out
 
     def lower(self, *args, **kwargs):
         state = self.spec.snapshot()

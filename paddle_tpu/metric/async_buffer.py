@@ -10,7 +10,11 @@ full between fences.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
+
+from ..profiler import telemetry as _telemetry
 
 __all__ = ["AsyncMetricBuffer"]
 
@@ -47,7 +51,18 @@ class AsyncMetricBuffer:
     def drain(self):
         """Fence: read back every pending scalar. Returns the new floats."""
         pending, self._pending = self._pending, []
-        new = [float(np.asarray(v)) for v in pending]
+        if not pending:
+            return []
+        if _telemetry.enabled():
+            t0 = time.perf_counter_ns()
+            new = [float(np.asarray(v)) for v in pending]
+            t1 = time.perf_counter_ns()
+            tm = _telemetry.get_telemetry()
+            tm.add_phase("readback", t0, t1)
+            tm.inc("metric.fences")
+            tm.inc("metric.scalars_read", len(new))
+        else:
+            new = [float(np.asarray(v)) for v in pending]
         self.values.extend(new)
         return new
 

@@ -263,6 +263,9 @@ class ProfilerResult:
         self.xplane_dir = xplane_dir
 
     def save(self, path, format="json"):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
         if format == "json":
             data = {
                 "traceEvents": [
@@ -378,6 +381,11 @@ class Profiler:
     def stop(self):
         if self in _ACTIVE_PROFILERS:
             _ACTIVE_PROFILERS.remove(self)
+        if self._step_t0 is not None:
+            # the in-flight step (started by start()/the last step()) ends
+            # here — keep its duration so step_info() reflects the last step
+            self._step_times.append(time.perf_counter() - self._step_t0)
+            self._step_t0 = None
         self._stop_device()
         if self.current_state in (ProfilerState.RECORD,
                                   ProfilerState.RECORD_AND_RETURN):
@@ -409,13 +417,17 @@ class Profiler:
         self._step_t0 = time.perf_counter()
 
     def step_info(self, unit=None):
+        """Rolling last-10-step timing line; ``unit`` is one of
+        ``'s'``/``'ms'``/``'us'`` (default ``'ms'``)."""
         if not self._step_times:
             return "no steps recorded"
         import numpy as np
 
-        arr = np.asarray(self._step_times[-10:])
-        return (f"step {self.step_num}: avg {arr.mean() * 1e3:.3f} ms, "
-                f"max {arr.max() * 1e3:.3f} ms, min {arr.min() * 1e3:.3f} ms")
+        scale, suffix = {"s": (1.0, "s"), "ms": (1e3, "ms"),
+                         "us": (1e6, "us")}.get(unit or "ms", (1e3, "ms"))
+        arr = np.asarray(self._step_times[-10:]) * scale
+        return (f"step {self.step_num}: avg {arr.mean():.3f} {suffix}, "
+                f"max {arr.max():.3f} {suffix}, min {arr.min():.3f} {suffix}")
 
     # -- device (XPlane) capture --
     def _wants_device(self):
@@ -454,8 +466,16 @@ class Profiler:
     def _finalize(self):
         if _native_state["owner"] is self:
             _drain_native_tracer(self._events)
+        events = list(self._events)
+        # pipeline telemetry spans (data_wait/h2d_copy/compile/dispatch/
+        # readback, same perf_counter_ns clock) merge into the chrome trace
+        from . import telemetry as _telemetry
+
+        for name, s_ns, e_ns, tid in _telemetry.get_telemetry().chrome_spans():
+            events.append(_HostEvent(f"telemetry::{name}", "Telemetry",
+                                     tid, s_ns, e_ns))
         self.profiler_result = ProfilerResult(
-            self._events,
+            events,
             extra_info={"steps": self.step_num},
             xplane_dir=self._xplane_dir,
         )
@@ -478,9 +498,16 @@ class Profiler:
             d[1] += dur
             d[2] = min(d[2], dur)
             d[3] = max(d[3], dur)
-        key_idx = {SortedKeys.CPUTotal: 1, SortedKeys.CPUAvg: 1,
-                   SortedKeys.CPUMax: 3, SortedKeys.CPUMin: 2}.get(sorted_by, 1)
-        rows = sorted(agg.items(), key=lambda kv: -kv[1][key_idx])
+        key_fn = {SortedKeys.CPUTotal: lambda d: d[1],
+                  SortedKeys.CPUAvg: lambda d: d[1] / d[0],
+                  SortedKeys.CPUMax: lambda d: d[3],
+                  SortedKeys.CPUMin: lambda d: d[2],
+                  SortedKeys.GPUTotal: lambda d: d[1],
+                  SortedKeys.GPUAvg: lambda d: d[1] / d[0],
+                  SortedKeys.GPUMax: lambda d: d[3],
+                  SortedKeys.GPUMin: lambda d: d[2]}.get(
+                      sorted_by, lambda d: d[1])
+        rows = sorted(agg.items(), key=lambda kv: -key_fn(kv[1]))
         lines = [f"{'Name':<40} {'Calls':>6} {'Total(ms)':>12} "
                  f"{'Avg(ms)':>10} {'Min(ms)':>10} {'Max(ms)':>10}"]
         lines.append("-" * 92)

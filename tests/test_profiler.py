@@ -10,8 +10,8 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import profiler
 from paddle_tpu.profiler import (
-    Profiler, ProfilerState, ProfilerTarget, RecordEvent, export_chrome_tracing,
-    load_profiler_result, make_scheduler,
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent, SortedKeys,
+    export_chrome_tracing, load_profiler_result, make_scheduler,
 )
 
 
@@ -89,3 +89,105 @@ def test_context_manager_with_step_range_scheduler():
             prof.step()
     assert prof.step_num == 4
     assert "step" in prof.step_info()
+
+
+# ---------------------------------------------------------------------------
+# make_scheduler state-machine edges
+# ---------------------------------------------------------------------------
+
+def test_make_scheduler_skip_first_only_delays_the_cycle():
+    sched = make_scheduler(closed=2, ready=1, record=1, skip_first=3)
+    # steps 0-2 are the skip_first window, CLOSED regardless of the cycle
+    assert [sched(i) for i in range(3)] == [ProfilerState.CLOSED] * 3
+    # then the cycle starts from its beginning: closed,closed,ready,record
+    assert sched(3) == ProfilerState.CLOSED
+    assert sched(4) == ProfilerState.CLOSED
+    assert sched(5) == ProfilerState.READY
+    assert sched(6) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_make_scheduler_single_step_record_window():
+    # record=1: the sole record step of each cycle must RECORD_AND_RETURN
+    sched = make_scheduler(closed=0, ready=0, record=1, repeat=2)
+    assert sched(0) == ProfilerState.RECORD_AND_RETURN
+    assert sched(1) == ProfilerState.RECORD_AND_RETURN
+    assert sched(2) == ProfilerState.CLOSED  # repeat exhausted
+
+
+def test_make_scheduler_repeat_exhaustion_stays_closed():
+    sched = make_scheduler(closed=1, ready=0, record=2, repeat=2, skip_first=1)
+    period = 3
+    for i in range(1 + 2 * period, 1 + 2 * period + 10):
+        assert sched(i) == ProfilerState.CLOSED
+    # repeat=0 never exhausts
+    sched0 = make_scheduler(closed=1, ready=0, record=2, repeat=0)
+    assert sched0(3 * 1000 + 2) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_make_scheduler_negative_step_raises():
+    sched = make_scheduler(closed=1, ready=1, record=1)
+    import pytest
+
+    with pytest.raises(ValueError):
+        sched(-1)
+
+
+# ---------------------------------------------------------------------------
+# satellite fixes: summary sort, stop() in-flight step, save dirs, units
+# ---------------------------------------------------------------------------
+
+def _profiler_with_events(events):
+    from paddle_tpu.profiler.profiler import _HostEvent
+
+    prof = Profiler(targets=[ProfilerTarget.CPU], on_trace_ready=lambda p: None)
+    prof._events = [_HostEvent(name, "PythonUserDefined", 0, s, e)
+                    for name, s, e in events]
+    return prof
+
+
+def test_summary_sorted_by_avg_uses_per_call_average(capsys):
+    # A: 1 call of 10ms; B: 10 calls of 1.2ms (total 12ms)
+    ms = 1_000_000
+    events = [("A", 0, 10 * ms)]
+    events += [("B", i * 20 * ms, i * 20 * ms + 12 * ms // 10)
+               for i in range(1, 11)]
+    prof = _profiler_with_events(events)
+    by_total = prof.summary(sorted_by=SortedKeys.CPUTotal)
+    by_avg = prof.summary(sorted_by=SortedKeys.CPUAvg)
+    capsys.readouterr()
+
+    def first_row_name(table):
+        return table.splitlines()[2].split()[0]
+
+    assert first_row_name(by_total) == "B"  # 12ms total beats 10ms
+    assert first_row_name(by_avg) == "A"    # 10ms avg beats 1.2ms
+
+
+def test_profiler_stop_keeps_inflight_step_duration():
+    prof = Profiler(targets=[ProfilerTarget.CPU], on_trace_ready=lambda p: None)
+    prof.start()
+    time.sleep(0.002)
+    prof.step()
+    time.sleep(0.002)
+    prof.stop()  # the in-flight step must not be dropped
+    assert len(prof._step_times) == 2
+    assert all(t >= 0.002 for t in prof._step_times)
+    assert "step" in prof.step_info()
+
+
+def test_profiler_result_save_creates_nested_dirs(tmp_path):
+    from paddle_tpu.profiler.profiler import ProfilerResult, _HostEvent
+
+    res = ProfilerResult([_HostEvent("x", "t", 0, 0, 1000)])
+    target = tmp_path / "deeply" / "nested" / "dir" / "trace.json"
+    res.save(str(target))  # must not throw on the missing parents
+    assert target.exists()
+    assert load_profiler_result(str(target)).events[0].name == "x"
+
+
+def test_step_info_honors_unit():
+    prof = Profiler(targets=[ProfilerTarget.CPU], on_trace_ready=lambda p: None)
+    prof._step_times = [0.5]
+    assert "500.000 ms" in prof.step_info()
+    assert "0.500 s" in prof.step_info(unit="s")
+    assert "500000.000 us" in prof.step_info(unit="us")

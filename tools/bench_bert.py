@@ -29,6 +29,7 @@ from bench_common import (  # noqa: E402
     compiled_flops,
     device_peak,
     measure_steps,
+    telemetry_block,
     retry,
 )
 
@@ -96,6 +97,7 @@ def _run_one(seq, batch=None, iters=None):
 
     total, _ = measure_steps(step, batches, iters)
     tokens_per_sec = batch * seq * iters / total
+    telemetry = telemetry_block(total, iters)
 
     kind, peak = device_peak()
     flops = compiled_flops(step, batches)
@@ -120,6 +122,7 @@ def _run_one(seq, batch=None, iters=None):
         "step_flops": flops,
         "hw_flops_util": round(hfu, 4) if hfu else None,
         "mfu": round(mfu, 4) if mfu else None,
+        "telemetry": telemetry,
     }
 
 

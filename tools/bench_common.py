@@ -60,32 +60,90 @@ def retry(run, attempts=3):
     raise last
 
 
-def measure_steps(step, batches, iters, warmup=3, prefetch=2):
+def measure_steps(step, batches, iters, warmup=3, prefetch=2,
+                  collect_telemetry=True):
     """Run the warmup+steady-state protocol; returns (seconds, losses).
 
     ``batches`` may be host batches (numpy tuples) or device Tensors; with
     ``prefetch > 0`` they are staged host→device through ``DeviceLoader``
     so transfers overlap compute, and losses are read back only after the
     timer stops (single fence on the last loss inside the timed region).
+
+    With ``collect_telemetry`` (default) the run enables the runtime
+    telemetry registry (reset first, spanning warmup so compile counts are
+    captured) and marks a phase record per measured step; summarize it into
+    the BENCH json with :func:`telemetry_block`. The per-step cost is a few
+    guarded ns-clock reads — noise against any real step.
     """
     from paddle_tpu.io import DeviceLoader
     from paddle_tpu.metric import AsyncMetricBuffer
 
-    feed = iter(DeviceLoader(batches, buffer_size=prefetch)
-                if prefetch else batches)
-    buf = AsyncMetricBuffer()
-    for _ in range(warmup):
-        loss = step(*next(feed))
-        np.asarray(loss._value)
-    t0 = time.perf_counter()
-    losses = [step(*next(feed)) for _ in range(iters)]
-    float(np.asarray(losses[-1]._value))  # fence on the dependence chain
-    total = time.perf_counter() - t0
-    for l in losses:
-        buf.append(l)
-    vals = buf.result()  # post-timer readback for the finiteness check
-    assert all(np.isfinite(v) for v in vals), f"bench losses not finite: {vals}"
-    return total, vals
+    telemetry = None
+    if collect_telemetry:
+        from paddle_tpu.profiler import telemetry
+
+        telemetry.reset()
+        telemetry.enable()
+    try:
+        feed = iter(DeviceLoader(batches, buffer_size=prefetch)
+                    if prefetch else batches)
+        buf = AsyncMetricBuffer()
+        for _ in range(warmup):
+            loss = step(*next(feed))
+            np.asarray(loss._value)
+        t0 = time.perf_counter()
+        losses = []
+        for _ in range(iters):
+            if telemetry is not None:
+                telemetry.step_begin()
+            losses.append(step(*next(feed)))
+        float(np.asarray(losses[-1]._value))  # fence on the dependence chain
+        total = time.perf_counter() - t0
+        if telemetry is not None:
+            telemetry.step_end()
+        for l in losses:
+            buf.append(l)
+        vals = buf.result()  # post-timer readback for the finiteness check
+        assert all(np.isfinite(v) for v in vals), \
+            f"bench losses not finite: {vals}"
+        return total, vals
+    finally:
+        if telemetry is not None:
+            telemetry.disable()  # data stays readable for telemetry_block
+
+
+def telemetry_block(total_seconds, steps):
+    """Phase-attribution block for the emitted BENCH json, from the
+    telemetry collected by ``measure_steps``: steps/s, mean data-wait
+    fraction of the timed region, compile/recompile counts, per-phase
+    seconds (measured steps only — warmup phases are outside the step
+    records), and DeviceLoader prefetch stats."""
+    from paddle_tpu.profiler import telemetry
+
+    s = telemetry.summary()
+    recs = telemetry.get_telemetry().steps()
+    phase_s = {}
+    for r in recs:
+        for k, v in r.phases.items():
+            phase_s[k] = phase_s.get(k, 0.0) + v
+    counters = s["counters"]
+    return {
+        "steps_per_sec": round(steps / total_seconds, 3) if total_seconds
+        else None,
+        "data_wait_frac": round(phase_s.get("data_wait", 0.0) / total_seconds,
+                                4) if total_seconds else None,
+        "compile_count": int(counters.get("compile.count", 0)),
+        "recompile_count": int(s["recompile_count"]),
+        "phase_s": {k: round(v, 6) for k, v in sorted(phase_s.items())},
+        "prefetch": {
+            "hits": int(counters.get("device_loader.prefetch_hit", 0)),
+            "misses": int(counters.get("device_loader.prefetch_miss", 0)),
+            "stall_s": round(float(
+                counters.get("device_loader.stall_s", 0.0)), 6),
+            "bytes_staged": int(
+                counters.get("device_loader.bytes_staged", 0)),
+        },
+    }
 
 
 def compiled_flops(step, batches):

@@ -27,6 +27,7 @@ from bench_common import (  # noqa: E402
     device_peak,
     emit,
     measure_steps,
+    telemetry_block,
     retry,
 )
 
@@ -91,6 +92,7 @@ def _run(batch=None, iters=None, artifact=True):
 
     total, _ = measure_steps(step, batches, iters)
     images_per_sec = batch * iters / total
+    telemetry = telemetry_block(total, iters)
 
     kind, peak = device_peak()
     flops = compiled_flops(step, batches)
@@ -109,6 +111,7 @@ def _run(batch=None, iters=None, artifact=True):
         "step_flops": flops,
         "hw_flops_util": round(hfu, 4) if hfu else None,
         "mfu_analytic": round(mfu_analytic, 4) if mfu_analytic else None,
+        "telemetry": telemetry,
     }, artifact="RESNET_r05.json" if (on_tpu and artifact) else None)
     return images_per_sec
 

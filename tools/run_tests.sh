@@ -56,6 +56,36 @@ else
   export PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
 fi
 
+# telemetry exporter smoke (full/fast paths): enable the runtime telemetry
+# registry, push a few spans through the LogWriter JSONL exporter, and
+# render the phase table with tools/telemetry_report.py — CI exercises the
+# whole export chain even when no test touches it
+if [[ "$MODE" != "slow" ]]; then
+  SMOKE_DIR="$(mktemp -d /tmp/pt_telemetry_smoke.XXXXXX)"
+  JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'PYEOF'
+import sys, time
+from paddle_tpu.profiler import telemetry
+from paddle_tpu.utils.log_writer import LogWriter
+
+telemetry.reset()
+telemetry.enable()
+tm = telemetry.get_telemetry()
+for _ in range(3):
+    telemetry.step_begin()
+    for phase in telemetry.PHASES:
+        with telemetry.phase_span(phase):
+            time.sleep(0.001)
+telemetry.step_end()
+tm.inc("smoke.batches", 3)
+tm.set_gauge("device_loader.queue_depth", 2)
+with LogWriter(sys.argv[1], file_name="telemetry_smoke.jsonl") as w:
+    tm.export_scalars(w, step=3)
+telemetry.disable()
+PYEOF
+  python tools/telemetry_report.py "$SMOKE_DIR/telemetry_smoke.jsonl"
+  rm -rf "$SMOKE_DIR"
+fi
+
 case "$MODE" in
   full)
     exec "${PY[@]}" tests/ "${ARGS[@]:-}"

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Render the pipeline phase-breakdown table from a telemetry JSONL.
+
+Reads the scalar stream written by ``profiler.telemetry.export_scalars``
+(via ``utils.log_writer.LogWriter`` — e.g. from the
+``hapi.callbacks.TelemetryLogger`` callback) and prints the same style of
+table as ``telemetry.report()``: cumulative per-phase totals, per-step
+phase samples, counters and gauges.
+
+Usage::
+
+    python tools/telemetry_report.py <vdlrecords.jsonl | logdir>
+
+Stdlib-only on purpose: the CI smoke path (tools/run_tests.sh) runs it
+without importing jax.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+PHASE_ORDER = ("data_wait", "h2d_copy", "compile", "dispatch", "readback")
+
+
+def load_records(path):
+    """Parse one JSONL file (or the newest ``*.jsonl`` in a directory)."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl")),
+                       key=os.path.getmtime)
+        if not files:
+            raise FileNotFoundError(f"no *.jsonl files under {path}")
+        path = files[-1]
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # tolerate partial trailing writes
+    return path, records
+
+
+def collect(records):
+    """Fold the scalar stream: cumulative tags keep their LAST value,
+    per-step samples (telemetry/step/<phase>_s) aggregate count/sum/max."""
+    last = {}
+    steps = {}
+    for r in records:
+        tag, value = r.get("tag"), r.get("value")
+        if not isinstance(tag, str) or value is None:
+            continue
+        if tag.startswith("telemetry/step/"):
+            name = tag[len("telemetry/step/"):]
+            if name.endswith("_s"):
+                name = name[:-2]
+            s = steps.setdefault(name, {"count": 0, "sum": 0.0, "max": 0.0})
+            s["count"] += 1
+            s["sum"] += float(value)
+            s["max"] = max(s["max"], float(value))
+        elif tag.startswith("telemetry/"):
+            last[tag] = float(value)
+    phases = {}
+    for tag, value in last.items():
+        if tag.startswith("telemetry/phase/"):
+            name, _, field = tag[len("telemetry/phase/"):].rpartition("/")
+            phases.setdefault(name, {})[field] = value
+    counters = {t[len("telemetry/counter/"):]: v for t, v in last.items()
+                if t.startswith("telemetry/counter/")}
+    gauges = {t[len("telemetry/gauge/"):]: v for t, v in last.items()
+              if t.startswith("telemetry/gauge/")}
+    return phases, steps, counters, gauges
+
+
+def build_table(phases, steps, counters, gauges):
+    lines = [f"{'Phase':<12} {'Count':>8} {'Total(s)':>12} {'Mean(ms)':>12} "
+             f"{'Frac(%)':>9}"]
+    lines.append("-" * 58)
+    denom = sum(p.get("total_s", 0.0) for p in phases.values()) or 1.0
+    order = [p for p in PHASE_ORDER if p in phases]
+    order += [p for p in sorted(phases) if p not in PHASE_ORDER]
+    for name in order:
+        p = phases[name]
+        total = p.get("total_s", 0.0)
+        count = int(p.get("count", 0))
+        mean = p.get("mean_s", total / count if count else 0.0)
+        lines.append(f"{name:<12} {count:>8} {total:>12.4f} "
+                     f"{mean * 1e3:>12.3f} {100.0 * total / denom:>9.2f}")
+    lines.append("-" * 58)
+    if steps:
+        lines.append(f"{'per-step samples':<21} {'N':>6} {'Mean(ms)':>12} "
+                     f"{'Max(ms)':>12}")
+        for name in sorted(steps):
+            s = steps[name]
+            mean = s["sum"] / s["count"] if s["count"] else 0.0
+            lines.append(f"  {name:<19} {s['count']:>6} {mean * 1e3:>12.3f} "
+                         f"{s['max'] * 1e3:>12.3f}")
+    if counters:
+        lines.append("counters:")
+        for k in sorted(counters):
+            v = counters[k]
+            lines.append(f"  {k:<38} {int(v) if v == int(v) else v}")
+    if gauges:
+        lines.append("gauges:")
+        for k in sorted(gauges):
+            lines.append(f"  {k:<38} {gauges[k]:g}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv and argv[0] in ("-h", "--help") else 2
+    path, records = load_records(argv[0])
+    phases, steps, counters, gauges = collect(records)
+    if not (phases or steps or counters or gauges):
+        print(f"{path}: no telemetry/* scalars found", file=sys.stderr)
+        return 1
+    print(f"telemetry report — {path}")
+    print(build_table(phases, steps, counters, gauges))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
