@@ -1,0 +1,85 @@
+"""paddle_tpu.analysis — static graph lint over compiled steps.
+
+The TPU-native analogue of the reference framework's IR pass layer
+(``framework/ir/Pass``): a step function is abstractly traced (no device
+execution) and a registry of rules inspects the jaxpr + input pytrees for
+the failure classes that telemetry (PR 2) could only report after the fact —
+retrace hazards, host-sync points, HBM waste, and TPU-unfriendly ops.
+
+Quick use::
+
+    from paddle_tpu import analysis
+    report = analysis.lint_step(compiled_step, batch_x, batch_y)
+    print(report.table())
+
+Framework hooks: ``analysis.enable_lint_on_compile()`` makes every
+``jit.CompiledStep`` lint itself (and warn) the first time it compiles;
+``hapi.Model.prepare(..., graph_lint=True)`` and
+``auto_parallel.Engine(..., graph_lint=True)`` lint once at the first fit.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .findings import SEVERITIES, Finding, LintReport  # noqa: F401
+from .graph_lint import (  # noqa: F401
+    LINT_DEFAULTS,
+    StepGraph,
+    lint_step,
+    trace_step,
+)
+from .crosscheck import RETRACE_RULES, crosscheck_telemetry  # noqa: F401
+from .rules import RULES, register_rule, rule_ids  # noqa: F401
+
+__all__ = [
+    "SEVERITIES", "Finding", "LintReport", "StepGraph", "LINT_DEFAULTS",
+    "lint_step", "trace_step", "crosscheck_telemetry", "RETRACE_RULES",
+    "RULES", "register_rule", "rule_ids",
+    "enable_lint_on_compile", "lint_on_compile_enabled", "autolint",
+]
+
+_ON_COMPILE = False
+
+
+def enable_lint_on_compile(flag=True):
+    """Opt-in: every ``CompiledStep`` lints itself on its first compile and
+    emits one ``RuntimeWarning`` per warning/error finding. Off by default —
+    the lint re-traces the step (host-side only, but not free)."""
+    global _ON_COMPILE
+    _ON_COMPILE = bool(flag)
+
+
+def lint_on_compile_enabled():
+    return _ON_COMPILE
+
+
+def autolint(step, args=(), kwargs=None, enabled=None, ignore=()):
+    """One-shot lint used by the framework integration points
+    (``CompiledStep.__call__`` on first compile, ``hapi.Model``/auto_parallel
+    ``Engine`` at first fit). Never raises — a lint bug must not take down a
+    training run — and lints each step object at most once per process.
+
+    Returns the :class:`LintReport`, or None when skipped/failed."""
+    if enabled is None:
+        enabled = _ON_COMPILE
+    if not enabled:
+        return None
+    # once-per-step-object guard as an attribute (an id() set would collide
+    # when a freed step's id is recycled)
+    if getattr(step, "_autolint_done", False):
+        return None
+    try:
+        step._autolint_done = True
+    except Exception:
+        pass
+    try:
+        report = lint_step(step, *tuple(args), ignore=ignore,
+                           **(kwargs or {}))
+    except Exception as e:  # noqa: BLE001 - advisory pass only
+        warnings.warn(f"graph lint failed on "
+                      f"'{getattr(step, 'name', step)}': {e!r}",
+                      RuntimeWarning, stacklevel=3)
+        return None
+    for f in report.at_least("warning"):
+        warnings.warn(f"[graph-lint] {f}", RuntimeWarning, stacklevel=3)
+    return report

@@ -1,0 +1,62 @@
+"""Static-prediction vs runtime-telemetry agreement.
+
+A ``retrace-*`` finding is a *prediction*: "this step will compile more than
+once". PR 2's telemetry counts what actually happened
+(``Telemetry.compile_counts``). This module joins the two so the analysis
+pass can be validated against reality — a lint that cries retrace on a step
+the runtime compiled exactly once is a lint bug, and vice versa.
+"""
+from __future__ import annotations
+
+__all__ = ["RETRACE_RULES", "crosscheck_telemetry"]
+
+#: rules whose findings predict >1 compilation of the step
+RETRACE_RULES = frozenset({
+    "retrace-state-structure",
+    "retrace-state-dtype",
+    "retrace-static-value",
+    "retrace-shape-churn",
+})
+
+
+def crosscheck_telemetry(report, telemetry_summary=None):
+    """Join a :class:`~.findings.LintReport` with telemetry compile counts.
+
+    Args:
+        report: the lint report (its findings carry the step name).
+        telemetry_summary: a ``Telemetry.summary()`` dict; defaults to the
+            process-wide registry's current summary.
+
+    Returns:
+        One dict per step name seen in the report::
+
+            {"step": name,
+             "predicted_retrace": bool,   # any retrace-family finding
+             "observed_compiles": int,    # telemetry compile count (0 = not
+                                          #  run under telemetry)
+             "agrees": bool | None}       # None until the step actually ran
+    """
+    if telemetry_summary is None:
+        from ..profiler import telemetry
+
+        telemetry_summary = telemetry.summary()
+    compiles = dict(telemetry_summary.get("compiles", {}))
+
+    steps = {}
+    for f in report:
+        name = f.step or report.step
+        steps[name] = steps.get(name, False) or (f.rule in RETRACE_RULES)
+    # a clean report still asserts "will NOT retrace" for its step
+    if not steps and report.step:
+        steps[report.step] = False
+
+    out = []
+    for name, predicted in sorted(steps.items()):
+        observed = int(compiles.get(name, 0))
+        out.append({
+            "step": name,
+            "predicted_retrace": predicted,
+            "observed_compiles": observed,
+            "agrees": ((observed > 1) == predicted) if observed else None,
+        })
+    return out

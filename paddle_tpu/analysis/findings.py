@@ -1,0 +1,148 @@
+"""Structured lint findings + the report container.
+
+The analysis pass (``graph_lint.py``) runs a registry of rules over an
+abstractly-traced step program; every rule yields :class:`Finding` objects —
+plain data, JSON-serializable, with enough provenance (pytree path or jaxpr
+equation source line) that a user can act on them without re-tracing
+anything. Mirrors the reference framework's ``framework/ir/Pass`` layer
+where graph passes attach structured messages to the inspected program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+__all__ = ["SEVERITIES", "Finding", "LintReport"]
+
+#: severity levels in ascending order
+SEVERITIES = ("info", "warning", "error")
+
+
+def _sev_rank(sev):
+    try:
+        return SEVERITIES.index(sev)
+    except ValueError:
+        return 0
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding.
+
+    Attributes:
+        rule: rule id (``retrace-state-structure``, ``host-sync-callback``…).
+        severity: ``info`` / ``warning`` / ``error``.
+        message: one-line human statement of the defect.
+        step: name of the analyzed step function.
+        path: pytree-path provenance (``args[0]``, ``state['optimizers']…``)
+            when the finding anchors to an input/state leaf, else "".
+        where: jaxpr equation provenance (user source ``file:line``) when the
+            finding anchors to a traced operation, else "".
+        hint: the suggested fix, copy-pasteable where possible.
+        data: rule-specific structured payload (shapes, byte counts, …).
+    """
+
+    rule: str
+    severity: str
+    message: str
+    step: str = ""
+    path: str = ""
+    where: str = ""
+    hint: str = ""
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def __str__(self):
+        loc = self.path or self.where
+        loc = f" [{loc}]" if loc else ""
+        hint = f" — {self.hint}" if self.hint else ""
+        return f"{self.severity}:{self.rule}{loc} {self.message}{hint}"
+
+
+class LintReport:
+    """Ordered collection of findings for one analyzed step (or several —
+    the CLI concatenates per-model reports). Sorted most-severe first."""
+
+    def __init__(self, findings=(), step=""):
+        self.findings = sorted(
+            findings, key=lambda f: -_sev_rank(f.severity))
+        self.step = step
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __bool__(self):
+        # truthiness = "has findings"; use .ok for the pass/fail gate
+        return bool(self.findings)
+
+    def by_rule(self, rule):
+        return [f for f in self.findings if f.rule == rule]
+
+    def at_least(self, severity):
+        r = _sev_rank(severity)
+        return [f for f in self.findings if _sev_rank(f.severity) >= r]
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == "warning"]
+
+    @property
+    def ok(self):
+        """True when no error-severity finding survived."""
+        return not self.errors
+
+    def extend(self, other):
+        self.findings = sorted(
+            list(self.findings) + list(other),
+            key=lambda f: -_sev_rank(f.severity))
+        return self
+
+    # -- export -------------------------------------------------------------
+    def to_jsonl(self, fh):
+        """One JSON object per finding; round-trips via
+        :meth:`Finding.from_dict` (see ``tools/graph_lint.py``)."""
+        for f in self.findings:
+            fh.write(json.dumps(f.as_dict(), sort_keys=True) + "\n")
+
+    @classmethod
+    def from_jsonl(cls, fh):
+        findings = []
+        for line in fh:
+            line = line.strip()
+            if line:
+                findings.append(Finding.from_dict(json.loads(line)))
+        return cls(findings)
+
+    def table(self):
+        """Render the findings as a fixed-width table (CLI / report uses)."""
+        if not self.findings:
+            return "graph lint: no findings"
+        head = f"{'Severity':<9} {'Rule':<26} {'Where':<34} Message"
+        lines = [head, "-" * len(head)]
+        for f in self.findings:
+            loc = (f.path or f.where)[:34]
+            lines.append(
+                f"{f.severity:<9} {f.rule:<26} {loc:<34} {f.message}")
+            if f.hint:
+                lines.append(f"{'':<9} {'':<26} {'':<34} ↳ {f.hint}")
+        counts = {}
+        for f in self.findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        lines.append("-" * len(head))
+        lines.append("totals: " + ", ".join(
+            f"{counts.get(s, 0)} {s}" for s in reversed(SEVERITIES)))
+        return "\n".join(lines)

@@ -1,0 +1,422 @@
+"""Lint-rule registry + the built-in rules.
+
+Each rule is a generator ``rule(graph) -> Iterable[Finding]`` over a
+:class:`~paddle_tpu.analysis.graph_lint.StepGraph` (the abstractly-traced
+step program: jaxpr + input/state pytrees + donation metadata). Rules are
+registered under a stable id; ``lint_step(..., ignore=("rule-id",))`` or the
+``PADDLE_TPU_LINT_IGNORE`` env var (comma list) silences them.
+
+Rule families (ISSUE 3):
+
+* ``retrace-*``    — hazards that force jax to re-trace/re-compile the step
+* ``host-sync-*``  — ops that stall the async pipeline on the host
+* ``hbm-*``        — device-memory waste visible in the lowered program
+* ``tpu-*``        — ops the TPU executes poorly (hot-path gathers, opaque
+                     custom calls XLA cannot fuse across)
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .findings import Finding
+
+__all__ = ["RULES", "register_rule", "rule_ids", "run_rules"]
+
+#: rule id -> (default_severity, one_line_doc, fn)
+RULES = {}
+
+
+def register_rule(rule_id, severity, doc):
+    def deco(fn):
+        RULES[rule_id] = (severity, doc, fn)
+        return fn
+
+    return deco
+
+
+def rule_ids():
+    return tuple(RULES)
+
+
+def run_rules(graph, ignore=()):
+    """Run every registered rule (minus ``ignore``) over the graph."""
+    findings = []
+    for rule_id, (_, _, fn) in RULES.items():
+        if rule_id in ignore:
+            continue
+        for f in fn(graph):
+            f.step = f.step or graph.name
+            findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# retrace hazards
+# ---------------------------------------------------------------------------
+@register_rule(
+    "retrace-state-structure", "error",
+    "state pytree structure changes inside the step: every call re-traces")
+def _state_structure(graph):
+    """The compiled step threads mutable framework state as an explicit
+    pytree. If the traced function RETURNS a state tree with a different
+    structure than it was given (classic case: optimizer accumulators
+    materializing lazily on the first step), the second call's input
+    signature differs from the first's and jax compiles the whole program
+    again — the Adam/AdamW double-trace PR 2's telemetry measured."""
+    if graph.state_in_treedef is None or graph.state_out_treedef is None:
+        return
+    if graph.state_in_treedef == graph.state_out_treedef:
+        return
+    in_paths = {p for p, _ in graph.state_in_paths}
+    out_paths = {p for p, _ in graph.state_out_paths}
+    added = sorted(out_paths - in_paths)
+    removed = sorted(in_paths - out_paths)
+    detail = []
+    if added:
+        detail.append(f"{len(added)} leaves appear during the step "
+                      f"(e.g. {', '.join(added[:4])})")
+    if removed:
+        detail.append(f"{len(removed)} leaves vanish "
+                      f"(e.g. {', '.join(removed[:4])})")
+    yield Finding(
+        rule="retrace-state-structure",
+        severity="error",
+        message="state pytree structure differs between step input and "
+                "output: " + ("; ".join(detail) or "treedef mismatch"),
+        path=(added or removed or ["state"])[0],
+        hint="materialize all state before compiling — for paddle_tpu "
+             "optimizers call opt._ensure_accumulators() (CompiledStep does "
+             "this for Optimizer instances) so accumulators exist from "
+             "step 1",
+        data={"added": added, "removed": removed},
+    )
+
+
+@register_rule(
+    "retrace-state-dtype", "warning",
+    "a state leaf changes shape/dtype across the step: re-traces once per "
+    "flip")
+def _state_dtype(graph):
+    if graph.state_in_treedef is None or graph.state_out_treedef is None:
+        return
+    if graph.state_in_treedef != graph.state_out_treedef:
+        return  # structure finding already covers it
+    out = dict(graph.state_out_paths)
+    for path, leaf in graph.state_in_paths:
+        sds = out.get(path)
+        if sds is None:
+            continue
+        in_shape, in_dtype = _shape_dtype(leaf)
+        out_shape, out_dtype = _shape_dtype(sds)
+        if in_shape != out_shape or in_dtype != out_dtype:
+            yield Finding(
+                rule="retrace-state-dtype",
+                severity="warning",
+                message=f"state leaf changes {in_dtype}{list(in_shape)} -> "
+                        f"{out_dtype}{list(out_shape)} across the step; the "
+                        f"next call re-traces with the new signature",
+                path=path,
+                hint="keep state leaves at a fixed shape/dtype (cast inside "
+                     "the step instead of letting the update promote)",
+            )
+
+
+@register_rule(
+    "retrace-static-scalar", "warning",
+    "python-scalar argument is baked into the program: new value = new "
+    "compile")
+def _static_scalar(graph):
+    """Python int/float/bool args are STATIC (op attributes, not tensors) —
+    deliberate for config flags, a recompile-per-step trap for values that
+    vary (step counters, schedules)."""
+    for path, value in graph.static_args:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        yield Finding(
+            rule="retrace-static-scalar",
+            severity="warning",
+            message=f"python scalar {value!r} at {path} is trace-static: "
+                    f"every distinct value compiles a new executable",
+            path=path,
+            hint=f"pass jnp.asarray({path}) (or a 0-d numpy array) if the "
+                 f"value varies between calls",
+        )
+
+
+@register_rule(
+    "retrace-static-value", "error",
+    "a static argument was observed with different values across example "
+    "batches")
+def _static_value_churn(graph):
+    for variant in graph.variants:
+        base = dict(graph.static_args)
+        for path, value in variant.get("static", ()):
+            if path in base and base[path] != value:
+                yield Finding(
+                    rule="retrace-static-value",
+                    severity="error",
+                    message=f"static argument {path} varies across example "
+                            f"batches ({base[path]!r} vs {value!r}): the "
+                            f"step re-compiles on every new value",
+                    path=path,
+                    hint="make the value an array input, or hoist it out of "
+                         "the per-step arguments",
+                )
+
+
+@register_rule(
+    "retrace-shape-churn", "warning",
+    "an input's shape/dtype varies across example batches: one executable "
+    "per distinct shape")
+def _shape_churn(graph):
+    base = {p: _shape_dtype(l) for p, l, _ in graph.dyn_args}
+    for variant in graph.variants:
+        for path, shape, dtype in variant.get("dyn", ()):
+            b = base.get(path)
+            if b is not None and b != (tuple(shape), str(dtype)):
+                yield Finding(
+                    rule="retrace-shape-churn",
+                    severity="warning",
+                    message=f"input {path} varies {b[1]}{list(b[0])} vs "
+                            f"{dtype}{list(shape)} across example batches: "
+                            f"each distinct signature compiles its own "
+                            f"executable",
+                    path=path,
+                    hint="pad batches to a fixed shape (DataLoader "
+                         "drop_last=True) so one cached executable serves "
+                         "every step",
+                )
+
+
+@register_rule(
+    "retrace-weak-type", "info",
+    "weakly-typed input leaf: strong/weak flips re-trace and promotions "
+    "surprise")
+def _weak_type(graph):
+    for path, leaf, _ in graph.dyn_args:
+        aval = getattr(leaf, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            yield Finding(
+                rule="retrace-weak-type",
+                severity="info",
+                message=f"input {path} is weakly typed (python-scalar "
+                        f"promotion semantics): a strongly-typed value at "
+                        f"the same path later re-traces",
+                path=path,
+                hint=f"pin the dtype: jnp.asarray(value, jnp.float32)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# host-sync points
+# ---------------------------------------------------------------------------
+#: callback-ish primitives -> severity ("readbacks inside the traced region")
+_SYNC_PRIMS = {
+    "pure_callback": "warning",
+    "io_callback": "warning",
+    "debug_callback": "info",
+    "debug_print": "info",
+    "host_callback": "warning",
+    "infeed": "error",
+    "outfeed": "error",
+}
+
+
+@register_rule(
+    "host-sync-callback", "warning",
+    "host callback inside the step: the device pipeline stalls on python")
+def _host_sync(graph):
+    for eqn, where in graph.eqns():
+        name = eqn.primitive.name
+        sev = _SYNC_PRIMS.get(name)
+        if sev is None:
+            continue
+        if name == "io_callback" and eqn.params.get("ordered"):
+            sev = "error"  # ordered effects serialize every step
+        yield Finding(
+            rule="host-sync-callback",
+            severity=sev,
+            message=f"`{name}` inside the compiled step round-trips to the "
+                    f"host every execution"
+                    + (" (ordered: serializes dispatch)"
+                       if sev == "error" and name == "io_callback" else ""),
+            where=where,
+            hint="move the readback outside the step (AsyncMetricBuffer "
+                 "defers it to fence points) or drop the callback from the "
+                 "hot path",
+        )
+
+
+# ---------------------------------------------------------------------------
+# HBM waste
+# ---------------------------------------------------------------------------
+@register_rule(
+    "hbm-undonated-input", "warning",
+    "large single-use input not donated: its HBM can't be reused by the "
+    "step")
+def _undonated(graph):
+    """Donation analysis: an un-donated input whose buffer the step could
+    alias to an output (same shape+dtype) or simply hand back to XLA for
+    temporaries. Emits the exact pytree path accepted by
+    ``CompiledStep(donate_inputs=[...])``."""
+    threshold = graph.config.get("donate_min_bytes", 1 << 20)
+    out_sigs = {}
+    for _, sds in graph.out_paths:
+        out_sigs.setdefault(_shape_dtype(sds), 0)
+        out_sigs[_shape_dtype(sds)] += 1
+    for path, leaf, donated in graph.dyn_args:
+        if donated:
+            continue
+        shape, dtype = _shape_dtype(leaf)
+        nbytes = _nbytes(leaf)
+        aliasable = out_sigs.get((shape, dtype), 0) > 0
+        if not aliasable and nbytes < threshold:
+            continue
+        why = (f"matches an output buffer {dtype}{list(shape)} (XLA would "
+               f"alias it in-place)" if aliasable else
+               f"{nbytes / 2**20:.1f} MiB held live across the step for "
+               f"nothing")
+        yield Finding(
+            rule="hbm-undonated-input",
+            severity="warning",
+            message=f"input {path} is single-use-shaped but not donated: "
+                    + why,
+            path=path,
+            hint=f'CompiledStep(..., donate_inputs=["{path}"]) — only if '
+                 f"the caller never reuses the batch after the call "
+                 f"(io.DeviceLoader batches qualify)",
+            data={"nbytes": int(nbytes), "aliasable": bool(aliasable)},
+        )
+
+
+@register_rule(
+    "hbm-const-folded", "warning",
+    "large array captured as a compile-time constant: duplicated into the "
+    "executable")
+def _const_folded(graph):
+    warn_bytes = graph.config.get("const_warn_bytes", 1 << 20)
+    error_bytes = graph.config.get("const_error_bytes", 64 << 20)
+    for const in graph.consts:
+        nbytes = _nbytes(const)
+        if nbytes < warn_bytes:
+            continue
+        shape, dtype = _shape_dtype(const)
+        yield Finding(
+            rule="hbm-const-folded",
+            severity="error" if nbytes >= error_bytes else "warning",
+            message=f"captured array {dtype}{list(shape)} "
+                    f"({nbytes / 2**20:.1f} MiB) is folded into the program "
+                    f"as a constant: it is copied into every executable "
+                    f"that closes over it and bloats compile time",
+            hint="thread it through the state pytree (Layer buffer) or pass "
+                 "it as an argument instead of closing over it",
+            data={"nbytes": int(nbytes)},
+        )
+
+
+@register_rule(
+    "hbm-f64-promotion", "warning",
+    "float64/complex128 values in the program: 2x HBM and no TPU support")
+def _f64(graph):
+    seen = 0
+    for eqn, where in graph.eqns():
+        for var in eqn.outvars:
+            dt = getattr(getattr(var, "aval", None), "dtype", None)
+            try:
+                wide = dt is not None and np.dtype(dt) in (
+                    np.dtype(np.float64), np.dtype(np.complex128))
+            except TypeError:  # extended dtypes (PRNG keys)
+                wide = False
+            if wide:
+                yield Finding(
+                    rule="hbm-f64-promotion",
+                    severity="warning",
+                    message=f"`{eqn.primitive.name}` produces {np.dtype(dt).name}: "
+                            f"double-width buffers, and TPUs emulate f64 at "
+                            f"a fraction of peak",
+                    where=where,
+                    hint="keep math in f32/bf16 (check np.float64 scalars "
+                         "leaking in via numpy defaults)",
+                )
+                seen += 1
+                break
+        if seen >= 4:  # cap the noise; one promotion usually cascades
+            return
+
+
+# ---------------------------------------------------------------------------
+# TPU-unfriendly ops
+# ---------------------------------------------------------------------------
+_SLOW_PRIMS = ("gather", "scatter", "scatter-add", "scatter-mul",
+               "scatter-min", "scatter-max", "sort", "top_k", "argsort")
+
+
+@register_rule(
+    "tpu-gather-scatter", "info",
+    "gathers/scatters/sorts on the hot path: serialized memory traffic on "
+    "TPU")
+def _gather_scatter(graph):
+    counts = {}
+    first_where = {}
+    for eqn, where in graph.eqns():
+        name = eqn.primitive.name
+        if name in _SLOW_PRIMS:
+            counts[name] = counts.get(name, 0) + 1
+            first_where.setdefault(name, where)
+    for name, n in sorted(counts.items()):
+        yield Finding(
+            rule="tpu-gather-scatter",
+            severity="info",
+            message=f"{n}x `{name}` in the step: dynamic indexing runs on "
+                    f"the TPU's scalar/vector units, not the MXU — fine for "
+                    f"embedding lookups, a red flag in inner loops",
+            where=first_where[name],
+            hint="prefer one_hot @ matmul or take_along_axis over repeated "
+                 "fancy indexing where the index set is dense",
+            data={"count": n},
+        )
+
+
+@register_rule(
+    "tpu-opaque-custom-call", "info",
+    "opaque custom call: XLA cannot fuse producers/consumers across it")
+def _custom_call(graph):
+    for eqn, where in graph.eqns():
+        name = eqn.primitive.name
+        if "custom_call" in name or name == "pallas_call":
+            yield Finding(
+                rule="tpu-opaque-custom-call",
+                severity="info",
+                message=f"`{name}` is opaque to the fusion pass: "
+                        f"surrounding elementwise work materializes to HBM "
+                        f"at its boundary",
+                where=where,
+                hint="fold pre/post elementwise math into the kernel itself "
+                     "if the boundary buffers show up in the profile",
+            )
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _shape_dtype(leaf):
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return shape, "?"
+    try:
+        return shape, str(np.dtype(dtype))
+    except TypeError:  # extended dtypes (PRNG key arrays etc.)
+        return shape, str(dtype)
+
+
+def _nbytes(leaf):
+    shape, dtype = _shape_dtype(leaf)
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * itemsize

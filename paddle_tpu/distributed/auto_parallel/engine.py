@@ -27,8 +27,14 @@ def _to_list(x):
 
 class Engine:
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
-                 cluster=None, strategy=None, process_mesh=None):
+                 cluster=None, strategy=None, process_mesh=None,
+                 graph_lint=None):
         self.model = model
+        # graph_lint=True: statically lint the compiled SPMD step against
+        # the first fit batch (paddle_tpu.analysis) and warn on findings;
+        # None follows analysis.enable_lint_on_compile(), False disables
+        self._graph_lint = graph_lint
+        self._graph_linted = False
         self._loss = loss
         self._optimizer = optimizer
         self._metrics = _to_list(metrics)
@@ -313,6 +319,17 @@ class Engine:
                 if self._auto_plan_pending:
                     self._auto_plan(first[0], first[1])
                 step = self._ensure_train()
+                if not self._graph_linted:
+                    self._graph_linted = True
+                    from ... import analysis
+
+                    # donation advice is noise where _ensure_train
+                    # deliberately disabled it (forced-host CPU mesh)
+                    ignore = (("hbm-undonated-input",)
+                              if not step.donate_inputs else ())
+                    analysis.autolint(step, (first[0], first[1]),
+                                      enabled=self._graph_lint,
+                                      ignore=ignore)
                 it = itertools.chain([first], it)
             if prefetch:
                 it = iter(DeviceLoader(it, buffer_size=prefetch,

@@ -63,12 +63,20 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._pred_step = None
+        self._graph_lint = None
+        self._graph_linted = False
 
     # ------------------------------------------------------------------
     # setup
     # ------------------------------------------------------------------
-    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
-        """Reference ``model.py:1499``."""
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
+                graph_lint=None):
+        """Reference ``model.py:1499``.
+
+        ``graph_lint=True`` statically lints the compiled train step against
+        the first batch of the first fit (``paddle_tpu.analysis``) and warns
+        on findings; ``None`` (default) follows the process-wide
+        ``analysis.enable_lint_on_compile()`` flag, ``False`` disables."""
         self._optimizer = optimizer
         if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
             raise TypeError("loss must be a Layer or callable")
@@ -80,6 +88,8 @@ class Model:
         self._train_step = None
         self._eval_step = None
         self._pred_step = None
+        self._graph_lint = graph_lint
+        self._graph_linted = False
 
     def _compute_loss(self, outputs, labels):
         outs = _to_list(outputs)
@@ -173,7 +183,16 @@ class Model:
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer, loss, ...) before training")
         ins, labs = self._split_batch(inputs, labels)
-        res = self._ensure_train_step()(*(ins + labs))
+        step = self._ensure_train_step()
+        if not self._graph_linted:
+            # one-shot static lint against the first real batch (opt-in via
+            # prepare(graph_lint=True) or analysis.enable_lint_on_compile())
+            self._graph_linted = True
+            from .. import analysis
+
+            analysis.autolint(step, tuple(ins + labs),
+                              enabled=self._graph_lint)
+        res = step(*(ins + labs))
         return res[0], res[1:], labs
 
     def _eval_batch_device(self, inputs, labels=None):

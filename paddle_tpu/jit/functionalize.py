@@ -31,6 +31,20 @@ from ..optimizer.optimizer import Optimizer
 
 __all__ = ["functionalize", "CompiledStep", "to_static", "not_to_static"]
 
+_analysis_mod = None
+
+
+def _analysis():
+    """Cached handle to paddle_tpu.analysis (lazy: keep the graph-lint
+    subsystem off the import path and the per-call flag check at attribute-
+    access cost)."""
+    global _analysis_mod
+    if _analysis_mod is None:
+        from .. import analysis as _a
+
+        _analysis_mod = _a
+    return _analysis_mod
+
 
 def _layer_refs(layer: Layer):
     refs = {"params": {}, "buffers": {}}
@@ -55,6 +69,17 @@ class _StateSpec:
             if not isinstance(s, (Layer, Optimizer)) and hasattr(s, "_state_pytree")
         ]
         self._refs = [_layer_refs(l) for l in self.layers]
+        # materialize optimizer accumulators BEFORE the first snapshot: lazy
+        # creation inside the first traced step changes the state pytree
+        # between calls 1 and 2 and forces a second trace+compile (the
+        # Adam/AdamW double-trace PR 2's telemetry measured; graph-lint's
+        # retrace-state-structure rule catches the pattern statically).
+        # "others" covered too: sharded-optimizer wrappers delegate the
+        # method to their inner Optimizer via __getattr__.
+        for o in self.optimizers + self.others:
+            ensure = getattr(o, "_ensure_accumulators", None)
+            if ensure is not None:
+                ensure()
 
     def snapshot(self):
         # read through the refs cached at construction instead of re-walking
@@ -115,20 +140,25 @@ class _Dyn:
 _DYN = _Dyn()
 
 
-def _partition_args(args, kwargs):
-    """Split the (args, kwargs) tree into traced array leaves and a hashable
-    static remainder. Python scalars/strings are STATIC — they are op
-    attributes in the reference's ProgramDesc, not tensors — so a new value
-    recompiles rather than becoming a tracer (this is what lets python
-    control flow on them unroll at trace time)."""
+def _is_dynamic_leaf(leaf):
+    """Traced-array leaf vs static python attribute. Python scalars/strings
+    are STATIC — they are op attributes in the reference's ProgramDesc, not
+    tensors — so a new value recompiles rather than becoming a tracer (this
+    is what lets python control flow on them unroll at trace time)."""
     import numpy as np
 
+    return (isinstance(leaf, (jax.Array, np.ndarray, np.generic))
+            or _is_tracer_val(leaf))
+
+
+def _partition_args(args, kwargs):
+    """Split the (args, kwargs) tree into traced array leaves and a hashable
+    static remainder (see ``_is_dynamic_leaf`` for the boundary)."""
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
     dyn = []
     spec = []
     for leaf in leaves:
-        if (isinstance(leaf, (jax.Array, np.ndarray, np.generic))
-                or _is_tracer_val(leaf)):
+        if _is_dynamic_leaf(leaf):
             dyn.append(leaf)
             spec.append(_DYN)
         else:
@@ -150,6 +180,15 @@ def _is_tracer_val(x):
     return _is_tracer(x)
 
 
+def _arg_path_str(path):
+    """(args, kwargs) pytree path -> the user-facing ``args[i]…`` /
+    ``kwargs['k']…`` form used by ``donate_inputs=[…]`` and the graph-lint
+    findings."""
+    head, rest = path[0], tuple(path[1:])
+    base = "args" if getattr(head, "idx", 0) == 0 else "kwargs"
+    return base + jax.tree_util.keystr(rest)
+
+
 class CompiledStep:
     """A cached compiled XLA step (≙ the reference's compiled-program cache in
     ``fluid/executor.py`` + InterpreterCore instruction list)."""
@@ -164,16 +203,27 @@ class CompiledStep:
         self._trace_marker = {"traced": False}
         self.spec = _StateSpec(stateful)
         self._pure = self._build_pure()
+        # donate_inputs: staged single-use batches (io.DeviceLoader) hand
+        # their HBM back to XLA for the step's own temporaries. Contract:
+        # donated inputs are CONSUMED — the caller must not touch a batch
+        # after passing it in. Besides True/False it accepts an iterable of
+        # argument pytree paths ("args[0]", "kwargs['x']…" — the exact form
+        # graph-lint's hbm-undonated-input finding prints) to donate only
+        # those leaves.
+        if isinstance(donate_inputs, bool):
+            self._donate_paths = None
+            self.donate_inputs = donate_inputs
+        else:
+            self._donate_paths = tuple(str(p) for p in donate_inputs)
+            self.donate_inputs = bool(self._donate_paths)
+        self._donate_mask_cache = {}
+        self.donate_state = bool(donate_state)
         donate = (0,) if donate_state else ()
-        if donate_inputs:
-            # donate the traced batch leaves too: staged single-use batches
-            # (io.DeviceLoader) hand their HBM back to XLA for the step's
-            # own temporaries. Contract: donated inputs are CONSUMED — the
-            # caller must not touch a batch after passing it in.
-            donate = donate + (1,)
-        self.donate_inputs = bool(donate_inputs)
+        # argnum 1 is the donated-leaves list: empty unless donation was
+        # requested, so donating it unconditionally is free
+        donate = donate + (1,)
         self._jitted = jax.jit(
-            self._pure, donate_argnums=donate, static_argnums=(2,),
+            self._pure, donate_argnums=donate, static_argnums=(3,),
             static_argnames=static_argnames
         )
 
@@ -182,14 +232,17 @@ class CompiledStep:
         fn = self.fn
         marker = self._trace_marker
 
-        def pure(state, dyn_leaves, static_spec):
+        def pure(state, dyn_donated, dyn_kept, static_spec):
             marker["traced"] = True
-            treedef, static_leaves = static_spec
+            treedef, static_leaves, don_mask = static_spec
+            it_d, it_k, it_m = iter(dyn_donated), iter(dyn_kept), iter(don_mask)
             if static_leaves is None:
-                leaves = list(dyn_leaves)
+                leaves = [next(it_d) if next(it_m) else next(it_k)
+                          for _ in range(len(don_mask))]
             else:
-                it = iter(dyn_leaves)
-                leaves = [next(it) if s is _DYN else s for s in static_leaves]
+                leaves = [((next(it_d) if next(it_m) else next(it_k))
+                           if s is _DYN else s)
+                          for s in static_leaves]
             args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
             prev = spec.snapshot()
             spec.install(state)
@@ -206,20 +259,55 @@ class CompiledStep:
 
         return pure
 
+    def _donation_mask(self, tree, treedef, spec_t, n_dyn):
+        """Per-dyn-leaf donate flags. Bool modes are trivial; path mode
+        resolves ``self._donate_paths`` against the leaf paths once per
+        (treedef, spec) signature and caches the mask."""
+        if self._donate_paths is None:
+            return ((True,) * n_dyn if self.donate_inputs
+                    else (False,) * n_dyn)
+        key = (treedef, spec_t) if spec_t is not None else None
+        mask = self._donate_mask_cache.get(key) if key is not None else None
+        if mask is None or len(mask) != n_dyn:
+            flags = []
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                if spec_t is not None and not _is_dynamic_leaf(leaf):
+                    continue
+                p = _arg_path_str(path)
+                flags.append(any(p == d or p.startswith(d)
+                                 for d in self._donate_paths))
+            mask = tuple(flags)
+            if key is not None:
+                self._donate_mask_cache[key] = mask
+        return mask
+
     def _prepare(self, args, kwargs):
         arr_args = jax.tree_util.tree_map(_unwrap, args)
         arr_kwargs = jax.tree_util.tree_map(_unwrap, kwargs)
-        return _partition_args(arr_args, arr_kwargs)
+        dyn, (treedef, spec_t) = _partition_args(arr_args, arr_kwargs)
+        mask = self._donation_mask((arr_args, arr_kwargs), treedef, spec_t,
+                                   len(dyn))
+        dyn_donated = [l for l, m in zip(dyn, mask) if m]
+        dyn_kept = [l for l, m in zip(dyn, mask) if not m]
+        return dyn_donated, dyn_kept, (treedef, spec_t, mask)
 
     def _invoke(self, args, kwargs):
         state = self.spec.snapshot()
-        dyn, static = self._prepare(args, kwargs)
-        out_arrays, new_state = self._jitted(state, dyn, static)
+        dyn_donated, dyn_kept, static = self._prepare(args, kwargs)
+        out_arrays, new_state = self._jitted(state, dyn_donated, dyn_kept,
+                                             static)
         self.spec.install(new_state)
         self.spec.clear_grads()
         return jax.tree_util.tree_map(lambda a: _wrap(a), out_arrays)
 
     def __call__(self, *args, **kwargs):
+        if (_analysis().lint_on_compile_enabled()
+                and not getattr(self, "_autolint_done", False)):
+            # opt-in warn-on-compile: lint BEFORE the first execution — the
+            # retrace hazards (lazily-materialized optimizer state) are only
+            # visible in the PRE-step state pytree; after one real step the
+            # state has stabilized and the defect is invisible statically
+            _analysis().autolint(self, args, kwargs, enabled=True)
         if not _telemetry.enabled():
             return self._invoke(args, kwargs)
         marker = self._trace_marker
@@ -237,10 +325,16 @@ class CompiledStep:
             tm.add_phase("dispatch", t0, t1)
         return out
 
+    def analyze(self, *args, **kwargs):
+        """Statically lint this step against the example batch — abstract
+        trace only, nothing runs on device. Returns a
+        :class:`paddle_tpu.analysis.LintReport`."""
+        return _analysis().lint_step(self, *args, **kwargs)
+
     def lower(self, *args, **kwargs):
         state = self.spec.snapshot()
-        dyn, static = self._prepare(args, kwargs)
-        return self._jitted.lower(state, dyn, static)
+        dyn_donated, dyn_kept, static = self._prepare(args, kwargs)
+        return self._jitted.lower(state, dyn_donated, dyn_kept, static)
 
 
 def functionalize(fn=None, *, stateful=(), donate_state=True,
@@ -257,6 +351,9 @@ def functionalize(fn=None, *, stateful=(), donate_state=True,
 
     ``donate_inputs=True`` additionally donates the batch arrays (see
     ``CompiledStep``): use with single-use staged batches only.
+    ``donate_inputs=["args[0]"]`` donates just the named argument pytree
+    paths — the exact strings graph-lint's ``hbm-undonated-input`` finding
+    prints.
     """
 
     def deco(f):

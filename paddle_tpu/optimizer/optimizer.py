@@ -314,6 +314,42 @@ class Optimizer:
             if k not in ("@step", "LR_Scheduler") and k not in applied
         }
 
+    # -- eager accumulator init ---------------------------------------------
+    def _eager_accumulator_specs(self):
+        """Declares every accumulator ``_update_param`` will touch for one
+        param, as ``[(name, _add_accumulator-kwargs)]``. Concrete optimizers
+        override this; it is the contract behind ``_ensure_accumulators``:
+        eager creation must land the SAME (name, shape, dtype) state the
+        lazy first step would, so the jit state pytree is identical either
+        way. ``()`` opts out (no accumulators, or an optimizer this base
+        doesn't know how to pre-build)."""
+        return ()
+
+    def _ensure_accumulators(self):
+        """Materialize all accumulators (and fp32 master weights) up front.
+
+        Lazy creation during the FIRST compiled step mutates the state
+        pytree between calls 1 and 2, forcing jax to trace+compile the whole
+        step twice (the Adam/AdamW double-trace found by PR 2's telemetry).
+        ``jit.CompiledStep`` calls this at construction so the state
+        signature is stable from step 1; safe to call repeatedly (existing
+        entries are kept, checkpoint-restored values in ``_pending_state``
+        are honored via ``_add_accumulator``'s restore path)."""
+        specs = self._eager_accumulator_specs()
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            master = self._uses_master(p)
+            if master:
+                self._master_weight(p)
+            for name, kw in specs:
+                kw = dict(kw)
+                if master and "dtype" not in kw:
+                    # the lazy path creates moments while p._value is the
+                    # fp32 master copy — match that dtype
+                    kw["dtype"] = jnp.float32
+                self._add_accumulator(name, p, **kw)
+
     # -- jit functionalization hooks ----------------------------------------
     def _state_pytree(self):
         return {

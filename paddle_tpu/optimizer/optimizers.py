@@ -39,6 +39,9 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
+    def _eager_accumulator_specs(self):
+        return (("velocity", {}),)
+
     def _update_param(self, p, grad, lr):
         v = self._add_accumulator("velocity", p)
         v_new = self._momentum * v + grad
@@ -56,6 +59,11 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+
+    def _eager_accumulator_specs(self):
+        return (("moment1", {}), ("moment2", {}),
+                ("beta1_pow", {"fill_value": 1.0, "shape": ()}),
+                ("beta2_pow", {"fill_value": 1.0, "shape": ()}))
 
     def _update_param(self, p, grad, lr):
         m = self._add_accumulator("moment1", p)
@@ -106,6 +114,10 @@ class Adamax(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
+    def _eager_accumulator_specs(self):
+        return (("moment", {}), ("inf_norm", {}),
+                ("beta1_pow", {"fill_value": 1.0, "shape": ()}))
+
     def _update_param(self, p, grad, lr):
         m = self._add_accumulator("moment", p)
         u = self._add_accumulator("inf_norm", p)
@@ -125,6 +137,9 @@ class Adagrad(Optimizer):
         self._epsilon = epsilon
         self._init_acc = initial_accumulator_value
 
+    def _eager_accumulator_specs(self):
+        return (("moment", {"fill_value": self._init_acc}),)
+
     def _update_param(self, p, grad, lr):
         acc = self._add_accumulator("moment", p, fill_value=self._init_acc)
         acc_new = acc + jnp.square(grad)
@@ -136,6 +151,9 @@ class Adadelta(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._epsilon, self._rho = epsilon, rho
+
+    def _eager_accumulator_specs(self):
+        return (("avg_squared_grad", {}), ("avg_squared_update", {}))
 
     def _update_param(self, p, grad, lr):
         avg_sq = self._add_accumulator("avg_squared_grad", p)
@@ -152,6 +170,12 @@ class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _eager_accumulator_specs(self):
+        specs = (("mean_square", {}), ("momentum", {}))
+        if self._centered:
+            specs += (("mean_grad", {}),)
+        return specs
 
     def _update_param(self, p, grad, lr):
         ms = self._add_accumulator("mean_square", p)
@@ -176,6 +200,11 @@ class Lamb(Optimizer):
         self._wd = lamb_weight_decay
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _eager_accumulator_specs(self):
+        return (("moment1", {}), ("moment2", {}),
+                ("beta1_pow", {"fill_value": 1.0, "shape": ()}),
+                ("beta2_pow", {"fill_value": 1.0, "shape": ()}))
 
     def _update_param(self, p, grad, lr):
         m = self._add_accumulator("moment1", p)

@@ -1,0 +1,488 @@
+"""paddle_tpu.analysis graph lint: one positive + one clean case per rule,
+finding provenance, CLI JSONL round-trip, framework wiring (CompiledStep
+warn-on-compile, hapi/Engine one-shot lint), and the lint-vs-telemetry
+crosscheck on the Adam lazy-accumulator retrace (pre-fix fixture) plus the
+recompile_count=0 regression for the fixed tree."""
+import importlib.util
+import json
+import os
+import re
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import analysis
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.profiler import telemetry
+
+
+def _plain_step(fn, **kw):
+    kw.setdefault("stateful", ())
+    kw.setdefault("donate_state", False)
+    return CompiledStep(fn, **kw)
+
+
+class _LazyAdam(paddle.optimizer.Adam):
+    """Pre-fix fixture: restore the lazy accumulator materialization that
+    caused the Adam/AdamW double-trace."""
+
+    def _ensure_accumulators(self):
+        pass
+
+
+def _adam_setup(opt_cls, name="train_step"):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 2))
+    opt = opt_cls(learning_rate=0.1, parameters=net.parameters())
+
+    def train_step(x, y):
+        loss = F.cross_entropy(net(x), y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # telemetry keys compile counts by step NAME: give each fixture its own
+    train_step.__name__ = name
+    step = CompiledStep(train_step, stateful=[net, opt])
+    x = Tensor(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+    y = Tensor(np.random.RandomState(1).randint(0, 2, (16, 1)).astype(np.int64))
+    return step, opt, x, y
+
+
+# ---------------------------------------------------------------------------
+# retrace-state-structure (+ the eager-init fix)
+# ---------------------------------------------------------------------------
+def test_retrace_state_structure_positive_lazy_adam():
+    step, _, x, y = _adam_setup(_LazyAdam)
+    report = step.analyze(x, y)
+    findings = report.by_rule("retrace-state-structure")
+    assert findings and findings[0].severity == "error"
+    assert not report.ok
+    # provenance: the exact state pytree paths that appear mid-step
+    assert "accumulators" in findings[0].path
+    assert any("moment1" in p for p in findings[0].data["added"])
+
+
+def test_retrace_state_structure_clean_fixed_adam():
+    step, opt, x, y = _adam_setup(paddle.optimizer.Adam)
+    # the fix: accumulators exist before the first trace
+    assert sorted(opt._accumulators) == ["beta1_pow", "beta2_pow",
+                                         "moment1", "moment2"]
+    report = step.analyze(x, y)
+    assert not report.by_rule("retrace-state-structure")
+    assert report.ok
+
+
+def test_eager_accumulators_match_lazy_state():
+    """Contract: eager init lands the SAME (name, shape, dtype) state one
+    lazy step would — for every optimizer that declares specs."""
+    from paddle_tpu.utils import unique_name
+
+    for opt_cls, kw in [(paddle.optimizer.Momentum, {}),
+                        (paddle.optimizer.Adam, {}),
+                        (paddle.optimizer.AdamW, {}),
+                        (paddle.optimizer.Adamax, {}),
+                        (paddle.optimizer.Adadelta, {}),
+                        (paddle.optimizer.RMSProp, {"centered": True}),
+                        (paddle.optimizer.Lamb, {})]:
+        with unique_name.guard():
+            paddle.seed(0)
+            lin_e = paddle.nn.Linear(4, 3)
+            eager = opt_cls(learning_rate=0.1, parameters=lin_e.parameters(),
+                            **kw)
+            eager._ensure_accumulators()
+        with unique_name.guard():
+            paddle.seed(0)
+            lin_l = paddle.nn.Linear(4, 3)
+            lazy = opt_cls(learning_rate=0.1, parameters=lin_l.parameters(),
+                           **kw)
+            out = lin_l(Tensor(np.ones((2, 4), np.float32)))
+            out.mean().backward()
+            lazy.step()
+
+        def sig(opt):
+            return {(name, key, tuple(v.shape), str(v.dtype))
+                    for name, store in opt._accumulators.items()
+                    for key, v in store.items()}
+
+        assert sig(eager) == sig(lazy), opt_cls.__name__
+
+
+def test_adam_recompile_count_zero_regression():
+    """BENCH acceptance: fixed Adam compiles exactly once over many steps."""
+    step, _, x, y = _adam_setup(paddle.optimizer.Adam)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            step(x, y)
+        s = telemetry.summary()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert s["compiles"] == {"train_step": 1}
+    assert s["recompile_count"] == 0
+
+
+def test_lint_vs_telemetry_crosscheck_adam():
+    """The static 'will retrace' prediction must agree with the runtime
+    recompile counter — both ways (pre-fix fixture vs fixed tree)."""
+    lazy_step, _, x, y = _adam_setup(_LazyAdam, name="lazy_train_step")
+    lazy_report = lazy_step.analyze(x, y)
+    fixed_step, _, _, _ = _adam_setup(paddle.optimizer.Adam,
+                                      name="fixed_train_step")
+    fixed_report = fixed_step.analyze(x, y)
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            lazy_step(x, y)
+            fixed_step(x, y)
+        summary = telemetry.summary()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+    (lazy_check,) = analysis.crosscheck_telemetry(lazy_report, summary)
+    assert lazy_check["predicted_retrace"] is True
+    assert lazy_check["observed_compiles"] == 2
+    assert lazy_check["agrees"] is True
+
+    (fixed_check,) = analysis.crosscheck_telemetry(fixed_report, summary)
+    assert fixed_check["predicted_retrace"] is False
+    assert fixed_check["observed_compiles"] == 1
+    assert fixed_check["agrees"] is True
+
+
+def test_analyze_leaves_eager_state_intact():
+    """The abstract trace must not leak tracers into framework state: the
+    step still runs (and numerically progresses) after analyze()."""
+    step, opt, x, y = _adam_setup(paddle.optimizer.Adam)
+    step.analyze(x, y)
+    l0 = float(np.asarray(step(x, y)._value))
+    l1 = float(np.asarray(step(x, y)._value))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+# ---------------------------------------------------------------------------
+# retrace-state-dtype
+# ---------------------------------------------------------------------------
+def _buffer_net(promote):
+    net = paddle.nn.Linear(4, 4)
+    net.register_buffer("scale", Tensor(jnp.ones((4,), jnp.float32)))
+
+    def step(x):
+        out = net(x) * net.scale
+        new = net.scale._value * 0.5
+        net.scale._value = new.astype(jnp.bfloat16) if promote else new
+        return out.mean()
+
+    return CompiledStep(step, stateful=[net])
+
+
+def test_retrace_state_dtype_positive_and_clean():
+    x = Tensor(np.ones((2, 4), np.float32))
+    dirty = _buffer_net(promote=True).analyze(x)
+    hits = dirty.by_rule("retrace-state-dtype")
+    assert hits and "scale" in hits[0].path and "bfloat16" in hits[0].message
+    clean = _buffer_net(promote=False).analyze(x)
+    assert not clean.by_rule("retrace-state-dtype")
+
+
+# ---------------------------------------------------------------------------
+# retrace-static-scalar / retrace-static-value / retrace-shape-churn
+# ---------------------------------------------------------------------------
+def test_retrace_static_scalar_positive_and_clean():
+    step = _plain_step(lambda x, k: x * k)
+    x = Tensor(np.ones((4,), np.float32))
+    report = step.analyze(x, 0.5)
+    hits = report.by_rule("retrace-static-scalar")
+    assert hits and hits[0].path == "args[1]"
+    clean = _plain_step(lambda x, k: x * k).analyze(
+        x, Tensor(np.float32(0.5)))
+    assert not clean.by_rule("retrace-static-scalar")
+
+
+def test_retrace_static_value_across_batches():
+    step = _plain_step(lambda x, k: x * k)
+    x = Tensor(np.ones((4,), np.float32))
+    report = analysis.lint_step(step, x, 0.5, extra_args=[(x, 0.75)])
+    hits = report.by_rule("retrace-static-value")
+    assert hits and hits[0].severity == "error" and hits[0].path == "args[1]"
+    same = analysis.lint_step(step, x, 0.5, extra_args=[(x, 0.5)])
+    assert not same.by_rule("retrace-static-value")
+
+
+def test_retrace_shape_churn_across_batches():
+    step = _plain_step(lambda x: (x * 2).sum())
+    b1 = Tensor(np.ones((8, 4), np.float32))
+    b2 = Tensor(np.ones((6, 4), np.float32))
+    report = analysis.lint_step(step, b1, extra_args=[(b2,)])
+    hits = report.by_rule("retrace-shape-churn")
+    assert hits and hits[0].path == "args[0]"
+    assert "[8, 4]" in hits[0].message and "[6, 4]" in hits[0].message
+    same = analysis.lint_step(step, b1, extra_args=[(b1,)])
+    assert not same.by_rule("retrace-shape-churn")
+
+
+def test_retrace_weak_type():
+    step = _plain_step(lambda x, s: x * s)
+    x = Tensor(np.ones((4,), np.float32))
+    report = step.analyze(x, Tensor(jnp.asarray(2.0)))  # weakly typed scalar
+    hits = report.by_rule("retrace-weak-type")
+    assert hits and hits[0].path == "args[1]"
+    clean = step.analyze(x, Tensor(jnp.asarray(2.0, jnp.float32)))
+    assert not clean.by_rule("retrace-weak-type")
+
+
+# ---------------------------------------------------------------------------
+# host-sync-callback
+# ---------------------------------------------------------------------------
+def test_host_sync_callback_positive_and_clean():
+    def noisy(x):
+        arr = x._value if isinstance(x, Tensor) else x
+        arr = jax.pure_callback(
+            lambda a: np.asarray(a) * 2.0,
+            jax.ShapeDtypeStruct(arr.shape, arr.dtype), arr)
+        return arr.sum()
+
+    report = _plain_step(noisy).analyze(Tensor(np.ones((4,), np.float32)))
+    hits = report.by_rule("host-sync-callback")
+    assert hits and hits[0].severity == "warning"
+    assert "pure_callback" in hits[0].message
+    assert re.match(r".+\.py:\d+$", hits[0].where)  # eqn provenance
+
+    clean = _plain_step(lambda x: (x * 2).sum()).analyze(
+        Tensor(np.ones((4,), np.float32)))
+    assert not clean.by_rule("host-sync-callback")
+
+
+# ---------------------------------------------------------------------------
+# hbm-undonated-input + donate_inputs pytree paths
+# ---------------------------------------------------------------------------
+def test_undonated_input_finding_names_exact_path():
+    step = _plain_step(lambda a, b: a * 2 + b.sum())
+    big = Tensor(jnp.ones((512, 513), jnp.float32))  # aliasable to output
+    small = Tensor(jnp.ones((8,), jnp.float32))
+    report = step.analyze(big, small)
+    hits = report.by_rule("hbm-undonated-input")
+    assert len(hits) == 1 and hits[0].path == "args[0]"
+    assert 'donate_inputs=["args[0]"]' in hits[0].hint
+
+
+def test_undonated_input_clean_when_donated():
+    step = _plain_step(lambda a, b: a * 2 + b.sum(), donate_inputs=True)
+    report = step.analyze(Tensor(jnp.ones((512, 513), jnp.float32)),
+                          Tensor(jnp.ones((8,), jnp.float32)))
+    assert not report.by_rule("hbm-undonated-input")
+
+
+def test_donate_inputs_by_path_consumes_only_named_leaf():
+    """The finding's path string round-trips into donate_inputs=[…]: the
+    named leaf is donated (buffer deleted), the rest stay alive."""
+    step = _plain_step(lambda a, b: a * 2 + b.sum(),
+                       donate_inputs=["args[0]"])
+    xa = jnp.ones((256, 256), jnp.float32)
+    xb = jnp.ones((8,), jnp.float32)
+    out = step(Tensor(xa), Tensor(xb))
+    np.asarray(out._value)
+    assert xa.is_deleted()
+    assert not xb.is_deleted()
+    # and the lint sees the path as donated
+    report = step.analyze(Tensor(jnp.ones((256, 256), jnp.float32)),
+                          Tensor(jnp.ones((8,), jnp.float32)))
+    assert not report.by_rule("hbm-undonated-input")
+
+
+# ---------------------------------------------------------------------------
+# hbm-const-folded
+# ---------------------------------------------------------------------------
+def test_const_folded_positive_and_clean():
+    big = jnp.ones((600, 600), jnp.float32)  # ~1.4 MiB > 1 MiB floor
+
+    report = _plain_step(lambda x: (x @ big).sum()).analyze(
+        Tensor(np.ones((2, 600), np.float32)))
+    hits = report.by_rule("hbm-const-folded")
+    assert hits and hits[0].severity == "warning"
+    assert hits[0].data["nbytes"] == 600 * 600 * 4
+
+    small = jnp.ones((4, 4), jnp.float32)
+    clean = _plain_step(lambda x: (x @ small).sum()).analyze(
+        Tensor(np.ones((2, 4), np.float32)))
+    assert not clean.by_rule("hbm-const-folded")
+
+
+# ---------------------------------------------------------------------------
+# hbm-f64-promotion
+# ---------------------------------------------------------------------------
+def test_f64_promotion_positive_and_clean():
+    jax.config.update("jax_enable_x64", True)
+    try:
+        report = _plain_step(
+            lambda x: x._value.astype(jnp.float64).sum()).analyze(
+            Tensor(np.ones((4,), np.float32)))
+        hits = report.by_rule("hbm-f64-promotion")
+        assert hits and "float64" in hits[0].message
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    clean = _plain_step(lambda x: (x * 2).sum()).analyze(
+        Tensor(np.ones((4,), np.float32)))
+    assert not clean.by_rule("hbm-f64-promotion")
+
+
+# ---------------------------------------------------------------------------
+# tpu-gather-scatter
+# ---------------------------------------------------------------------------
+def test_gather_scatter_positive_and_clean():
+    idx = jnp.asarray([0, 2, 1], jnp.int32)
+
+    report = _plain_step(
+        lambda x: jnp.take(x._value, idx, axis=0).sum()).analyze(
+        Tensor(np.ones((4, 3), np.float32)))
+    hits = report.by_rule("tpu-gather-scatter")
+    assert hits and hits[0].severity == "info"
+    assert hits[0].data["count"] >= 1
+    assert re.match(r".+\.py:\d+$", hits[0].where)
+
+    clean = _plain_step(lambda x: (x * 2 + 1).mean()).analyze(
+        Tensor(np.ones((4, 3), np.float32)))
+    assert not clean.by_rule("tpu-gather-scatter")
+
+
+# ---------------------------------------------------------------------------
+# rule silencing
+# ---------------------------------------------------------------------------
+def test_ignore_silences_rule():
+    step, _, x, y = _adam_setup(_LazyAdam)
+    report = analysis.lint_step(step, x, y,
+                                ignore=("retrace-state-structure",))
+    assert not report.by_rule("retrace-state-structure")
+
+
+def test_env_ignore_silences_rule(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LINT_IGNORE",
+                       "retrace-state-structure, tpu-gather-scatter")
+    step, _, x, y = _adam_setup(_LazyAdam)
+    report = step.analyze(x, y)
+    assert not report.by_rule("retrace-state-structure")
+    assert not report.by_rule("tpu-gather-scatter")
+
+
+# ---------------------------------------------------------------------------
+# framework wiring
+# ---------------------------------------------------------------------------
+def test_warn_on_compile_opt_in():
+    step, _, x, y = _adam_setup(_LazyAdam)
+    analysis.enable_lint_on_compile(True)
+    try:
+        with pytest.warns(RuntimeWarning, match=r"graph-lint.*retrace"):
+            step(x, y)
+        # once per step object: subsequent compiles don't re-warn
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            step(x, y)
+    finally:
+        analysis.enable_lint_on_compile(False)
+
+
+def test_lint_on_compile_disabled_is_silent():
+    step, _, x, y = _adam_setup(_LazyAdam)
+    assert not analysis.lint_on_compile_enabled()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        step(x, y)
+
+
+def test_hapi_prepare_graph_lint_warns_at_first_fit():
+    class _DS:
+        def __getitem__(self, i):
+            r = np.random.RandomState(i)
+            return (r.randn(8).astype(np.float32),
+                    np.asarray([i % 2], np.int64))
+
+        def __len__(self):
+            return 16
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    model = paddle.Model(net)
+    from paddle_tpu.nn import CrossEntropyLoss
+
+    model.prepare(_LazyAdam(learning_rate=0.1, parameters=net.parameters()),
+                  CrossEntropyLoss(), graph_lint=True)
+    with pytest.warns(RuntimeWarning, match=r"graph-lint.*retrace"):
+        model.fit(_DS(), batch_size=8, epochs=1, verbose=0)
+    assert model._graph_linted
+
+
+def test_engine_graph_lint_runs_once_at_first_fit():
+    from paddle_tpu.distributed.auto_parallel import Engine, ProcessMesh
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+
+    def loss_fn(out, y):
+        return ((out - y) ** 2).mean()
+
+    mesh = ProcessMesh(np.arange(len(jax.devices())), dim_names=["dp"])
+    eng = Engine(model=net, loss=loss_fn, optimizer=opt, process_mesh=mesh,
+                 graph_lint=True)
+    x = np.random.RandomState(0).randn(16, 8).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    eng.fit(list(zip(x, y)), batch_size=8, epochs=1, prefetch=0)
+    assert eng._graph_linted
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSONL round-trip + fixture gate
+# ---------------------------------------------------------------------------
+def _load_cli():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "graph_lint.py")
+    spec = importlib.util.spec_from_file_location("graph_lint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_jsonl_round_trip(tmp_path, capsys):
+    cli = _load_cli()
+    out = tmp_path / "findings.jsonl"
+    rc = cli.main(["--models", "mlp", "--jsonl", str(out),
+                   "--fail-on", "never"])
+    assert rc == 0
+    lines = [json.loads(l) for l in out.read_text().splitlines() if l]
+    assert lines, "mlp zoo entry produced no findings (gather is expected)"
+    for d in lines:
+        assert d["model"] == "mlp"
+        f = analysis.Finding.from_dict(d)
+        assert f.as_dict() == {k: v for k, v in d.items() if k != "model"}
+    table = capsys.readouterr().out
+    assert "mlp_train_step" in table and "graph lint:" in table
+
+
+def test_cli_adam_lazy_fixture_fails_the_gate(tmp_path):
+    cli = _load_cli()
+    out = tmp_path / "lazy.jsonl"
+    rc = cli.main(["--models", "mlp", "--fixture", "adam-lazy",
+                   "--jsonl", str(out)])
+    assert rc == 1
+    rules = {json.loads(l)["rule"] for l in out.read_text().splitlines() if l}
+    assert "retrace-state-structure" in rules
+
+
+def test_cli_clean_zoo_passes_the_gate():
+    cli = _load_cli()
+    assert cli.main(["--models", "mlp"]) == 0

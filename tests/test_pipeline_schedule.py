@@ -15,6 +15,8 @@ from paddle_tpu.jit.functionalize import CompiledStep
 from paddle_tpu.models import GPTConfig, GPTForCausalLM
 from paddle_tpu.utils import unique_name
 
+from capability import requires_spmd_partition_id
+
 
 def _cfg(layers=4, vocab=128, hidden=64, heads=4, seq=32):
     return GPTConfig(
@@ -63,7 +65,9 @@ def _loss_of(model, ids, labels):
 @pytest.mark.parametrize("dp,mp,pp,micro", [
     (1, 1, 2, 2),
     (1, 1, 4, 4),
-    (2, 2, 2, 2),
+    # dp/mp auto axes alongside the pp-manual shard_map emit PartitionId,
+    # which not every SPMD backend can place (capability-probed skip)
+    pytest.param(2, 2, 2, 2, marks=requires_spmd_partition_id()),
 ])
 def test_pipelined_gpt_matches_single_device(dp, mp, pp, micro):
     from paddle_tpu.distributed.meta_parallel import build_pipelined_gpt
@@ -125,6 +129,7 @@ def test_pipelined_gpt_matches_single_device(dp, mp, pp, micro):
                                err_msg="tied embedding after step")
 
 
+@requires_spmd_partition_id()
 def test_pipelined_gpt_compiled_step_trains():
     """Full hybrid dp*mp*pp CompiledStep over the pipelined model: loss
     decreases and stays finite (the dryrun_multichip path)."""

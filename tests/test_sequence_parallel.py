@@ -11,6 +11,8 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.framework.tensor import Tensor
 from paddle_tpu.utils import unique_name
 
+from capability import requires_spmd_partition_id
+
 
 def _init_fleet(dp=1, mp=1, pp=1, sep=1):
     strategy = fleet.DistributedStrategy()
@@ -82,6 +84,7 @@ def test_ring_attention_rectangular_heads_and_seq():
                                atol=2e-5)
 
 
+@requires_spmd_partition_id()
 def test_gpt_with_sep_matches_plain():
     """GPT flagship under dp2×sep2: same loss as the plain single-mesh model,
     gradients flow."""
@@ -117,6 +120,7 @@ def test_gpt_with_sep_matches_plain():
     np.testing.assert_allclose(g_sep, g_ref, atol=3e-5)
 
 
+@requires_spmd_partition_id()
 def test_gpt_sep_jitted_train_step():
     """The sep model trains inside one jitted step (CompiledStep)."""
     from paddle_tpu.jit.functionalize import CompiledStep

@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Graph-lint the bench model zoo (static analysis only — nothing executes
+on a device unless ``--run-steps`` is given).
+
+For each model this builds the same train step the benchmarks measure
+(``bench_resnet.py`` / ``bench_bert.py`` recipes at CPU smoke scale),
+abstractly traces it with ``paddle_tpu.analysis.lint_step`` against two
+example batches, prints the findings table, and (with ``--jsonl``) emits one
+JSON object per finding — ``Finding.as_dict()`` plus a ``model`` key;
+``Finding.from_dict`` round-trips the lines.
+
+Exit status: 1 when any finding at/above ``--fail-on`` severity survived
+(default ``error``) — ``tools/run_tests.sh`` smoke-runs this as a CI gate.
+
+``--fixture adam-lazy`` swaps every model's optimizer for a pre-fix Adam
+whose accumulators materialize lazily during the first step: the regression
+fixture for the retrace-state-structure rule (the Adam/AdamW double-trace
+PR 2's telemetry measured). ``--run-steps N`` additionally executes N real
+steps per model under telemetry and prints the static-prediction vs
+observed-compile-count crosscheck.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/graph_lint.py [--models mlp resnet bert]
+        [--jsonl PATH] [--fixture adam-lazy] [--fail-on error|warning|never]
+        [--run-steps N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _lazy_adam(paddle):
+    class LazyAdam(paddle.optimizer.Adam):
+        """Pre-fix fixture: defeat the eager accumulator init so moment/
+        beta-pow state materializes lazily inside the first traced step —
+        the state-pytree instability the lint must catch."""
+
+        def _ensure_accumulators(self):
+            pass
+
+    return LazyAdam
+
+
+def _step_of(model_fwd_loss, model, opt, name):
+    from paddle_tpu.jit.functionalize import CompiledStep
+
+    def train_step(x, y):
+        loss = model_fwd_loss(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = name
+    return CompiledStep(train_step, stateful=[model, opt], donate_state=True)
+
+
+def _batches(x_fn, y_fn, n=2):
+    from paddle_tpu.framework.tensor import Tensor
+
+    rng = np.random.RandomState(0)
+    return [(Tensor(x_fn(rng)), Tensor(y_fn(rng))) for _ in range(n)]
+
+
+def build_mlp(fixture=None):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(32, 64), paddle.nn.ReLU(),
+                               paddle.nn.Linear(64, 10))
+    opt_cls = (_lazy_adam(paddle) if fixture == "adam-lazy"
+               else paddle.optimizer.Adam)
+    opt = opt_cls(learning_rate=1e-3, parameters=net.parameters())
+
+    def fwd_loss(x, y):
+        return F.cross_entropy(net(x), y).mean()
+
+    step = _step_of(fwd_loss, net, opt, "mlp_train_step")
+    return step, _batches(
+        lambda r: r.randn(8, 32).astype(np.float32),
+        lambda r: r.randint(0, 10, (8, 1)).astype(np.int64))
+
+
+def build_resnet(fixture=None):
+    """ResNet-50 at the bench script's CPU smoke scale (32x32, 10 classes,
+    SGD+momentum — bench_resnet.py recipe)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=10)
+    if fixture == "adam-lazy":
+        opt = _lazy_adam(paddle)(learning_rate=0.1,
+                                 parameters=model.parameters())
+    else:
+        opt = paddle.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9, parameters=model.parameters(),
+            weight_decay=1e-4)
+
+    def fwd_loss(x, y):
+        return F.cross_entropy(model(x).astype("float32"), y,
+                               reduction="mean")
+
+    step = _step_of(fwd_loss, model, opt, "resnet_train_step")
+    return step, _batches(
+        lambda r: r.randn(4, 3, 32, 32).astype(np.float32),
+        lambda r: r.randint(0, 10, (4, 1)).astype(np.int64))
+
+
+def build_bert(fixture=None):
+    """BERT MLM at the bench script's CPU smoke config (bench_bert.py),
+    AdamW — the optimizer whose lazy double-trace this lint regression-
+    tests."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import BertConfig, BertForPretraining
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                     num_heads=2, intermediate_size=256,
+                     max_position_embeddings=64,
+                     hidden_dropout=0.0, attention_dropout=0.0)
+    model = BertForPretraining(cfg)
+    opt_cls = (_lazy_adam(paddle) if fixture == "adam-lazy"
+               else paddle.optimizer.AdamW)
+    opt = opt_cls(learning_rate=1e-4, parameters=model.parameters())
+
+    def fwd_loss(ids, labels):
+        return model.loss(ids, labels)
+
+    step = _step_of(fwd_loss, model, opt, "bert_train_step")
+    return step, _batches(
+        lambda r: r.randint(0, 512, (4, 64)).astype(np.int32),
+        lambda r: r.randint(0, 512, (4, 64)).astype(np.int32))
+
+
+ZOO = {"mlp": build_mlp, "resnet": build_resnet, "bert": build_bert}
+
+
+def lint_zoo(models, fixture=None, run_steps=0, out=sys.stdout):
+    """Returns ``[(model_name, LintReport)]`` (import-friendly: the tests
+    drive this directly)."""
+    from paddle_tpu import analysis
+
+    results = []
+    for name in models:
+        step, batches = ZOO[name](fixture=fixture)
+        x, y = batches[0]
+        report = analysis.lint_step(step, x, y, extra_args=batches[1:])
+        print(f"\n== {name} ({step.name}) ==", file=out)
+        print(report.table(), file=out)
+        if run_steps > 0:
+            from paddle_tpu.profiler import telemetry
+
+            telemetry.reset()
+            telemetry.enable()
+            try:
+                for _ in range(run_steps):
+                    step(x, y)
+                checks = analysis.crosscheck_telemetry(report)
+            finally:
+                telemetry.disable()
+            for c in checks:
+                print(f"crosscheck: predicted_retrace="
+                      f"{c['predicted_retrace']} observed_compiles="
+                      f"{c['observed_compiles']} agrees={c['agrees']}",
+                      file=out)
+        results.append((name, report))
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", nargs="+", default=["mlp", "resnet", "bert"],
+                    choices=sorted(ZOO))
+    ap.add_argument("--jsonl", default=None,
+                    help="write one JSON object per finding to this path")
+    ap.add_argument("--fixture", default=None, choices=["adam-lazy"],
+                    help="adam-lazy: pre-fix lazy-accumulator optimizer")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "never"],
+                    help="exit 1 when findings at/above this severity exist")
+    ap.add_argument("--run-steps", type=int, default=0,
+                    help="also run N real steps per model under telemetry "
+                         "and print the lint-vs-telemetry crosscheck")
+    args = ap.parse_args(argv)
+
+    results = lint_zoo(args.models, fixture=args.fixture,
+                       run_steps=args.run_steps)
+
+    if args.jsonl:
+        with open(args.jsonl, "w") as fh:
+            for name, report in results:
+                for f in report:
+                    fh.write(json.dumps({"model": name, **f.as_dict()},
+                                        sort_keys=True) + "\n")
+        print(f"\nwrote {sum(len(r) for _, r in results)} findings to "
+              f"{args.jsonl}")
+
+    n_err = sum(len(r.errors) for _, r in results)
+    n_warn = sum(len(r.warnings) for _, r in results)
+    print(f"\ngraph lint: {n_err} error(s), {n_warn} warning(s) across "
+          f"{len(results)} model(s)")
+    if args.fail_on == "never":
+        return 0
+    gate = n_err + (n_warn if args.fail_on == "warning" else 0)
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,6 +83,12 @@ with LogWriter(sys.argv[1], file_name="telemetry_smoke.jsonl") as w:
 telemetry.disable()
 PYEOF
   python tools/telemetry_report.py "$SMOKE_DIR/telemetry_smoke.jsonl"
+  # graph-lint gate: statically lint the bench-zoo train steps (resnet +
+  # bert, no device execution) — any error-severity finding (e.g. a
+  # state-pytree retrace hazard like the Adam lazy-accumulator
+  # double-trace) fails the runner via its exit status
+  JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert \
+    --jsonl "$SMOKE_DIR/graph_lint.jsonl"
   rm -rf "$SMOKE_DIR"
 fi
 
