@@ -97,6 +97,7 @@ from . import vision  # noqa: F401
 from . import static  # noqa: F401
 from . import profiler  # noqa: F401
 from . import analysis  # noqa: F401
+from . import fault  # noqa: F401
 from . import hapi  # noqa: F401
 from . import distribution  # noqa: F401
 from . import sparse  # noqa: F401

@@ -283,12 +283,20 @@ class Engine:
 
     # -- public API (reference engine.py fit/evaluate/predict) ---------------
     def fit(self, train_data, batch_size=1, epochs=1, steps_per_epoch=None,
-            verbose=0, collate_fn=None, prefetch=2, log_freq=10):
+            verbose=0, collate_fn=None, prefetch=2, log_freq=10,
+            resume=None, ckpt_freq=None, keep_last_n=None):
         """Train over ``train_data``. ``prefetch`` batches stage host→device
         behind a background thread (``io.DeviceLoader``, sharded over the
         mesh's data axis); per-step losses stay on device and fence only
         every ``log_freq`` steps + at epoch end. ``prefetch=0`` restores
-        the synchronous per-step path (debugging aid)."""
+        the synchronous per-step path (debugging aid).
+
+        ``resume`` (directory or ``fault.CheckpointManager``) enables
+        kill-and-resume: the newest verified checkpoint restores model /
+        optimizer / RNG / data-cursor state and the loop continues from the
+        interrupted step; SIGTERM flushes a final checkpoint and raises
+        ``fault.TrainingPreempted``. ``ckpt_freq`` adds periodic intra-epoch
+        saves; ``keep_last_n`` bounds retained checkpoints."""
         import itertools
 
         from ...io import DataLoader
@@ -296,6 +304,17 @@ class Engine:
         from ...metric import AsyncMetricBuffer
         from ...profiler import telemetry
 
+        sess = None
+        start_epoch = start_step = 0
+        if resume is not None:
+            from ...fault import ResumeSession
+
+            sess = ResumeSession(resume, self.model, self._optimizer,
+                                 keep_last_n=keep_last_n, ckpt_freq=ckpt_freq)
+            start_epoch, start_step = sess.restore()
+            # rebuild the compiled step over the restored state pytree
+            self._train_step = None
+            self._eval_step = None
         loader = (train_data if isinstance(train_data, DataLoader)
                   else DataLoader(train_data, batch_size=batch_size,
                                   shuffle=True, drop_last=True,
@@ -306,59 +325,77 @@ class Engine:
         # zero-overhead-when-disabled per-step phase timeline (see
         # hapi.Model._run_one_epoch for the step_begin placement rationale)
         tm_on = telemetry.enabled()
-        for epoch in range(epochs):
-            it = iter(loader)
-            if step is None:
-                # the first batch drives auto-planning (which may reshape
-                # the mesh), so it must be consumed BEFORE the prefetcher
-                # starts staging onto that mesh
-                try:
-                    first = next(it)
-                except StopIteration:
-                    break
-                if self._auto_plan_pending:
-                    self._auto_plan(first[0], first[1])
-                step = self._ensure_train()
-                if not self._graph_linted:
-                    self._graph_linted = True
-                    from ... import analysis
-
-                    # donation advice is noise where _ensure_train
-                    # deliberately disabled it (forced-host CPU mesh)
-                    ignore = (("hbm-undonated-input",)
-                              if not step.donate_inputs else ())
-                    analysis.autolint(step, (first[0], first[1]),
-                                      enabled=self._graph_lint,
-                                      ignore=ignore)
-                it = itertools.chain([first], it)
-            if prefetch:
-                it = iter(DeviceLoader(it, buffer_size=prefetch,
-                                       place_fn=self._place_array))
-            if tm_on:
-                telemetry.step_begin()
-            try:
-                for i, batch in enumerate(it):
-                    if steps_per_epoch is not None and i >= steps_per_epoch:
+        try:
+            for epoch in range(start_epoch, epochs):
+                if sess is not None:
+                    sess.epoch_begin(epoch)
+                it = iter(loader)
+                if step is None:
+                    # the first batch drives auto-planning (which may reshape
+                    # the mesh), so it must be consumed BEFORE the prefetcher
+                    # starts staging onto that mesh
+                    try:
+                        first = next(it)
+                    except StopIteration:
                         break
-                    x, y = batch[0], batch[1]
-                    if not prefetch:
-                        x = self._shard_batch(np.asarray(x._value))
-                        y = self._shard_batch(np.asarray(y._value))
-                    loss, out = step(x, y)
-                    buf.append(loss)
-                    if (i + 1) % log_freq == 0:
-                        buf.drain()
-                        if verbose:
-                            print(f"epoch {epoch} step {i}: "
-                                  f"loss {buf.last():.4f}")
-                    if tm_on:
-                        telemetry.step_begin()  # roll the phase record over
-            finally:
-                if hasattr(it, "close"):
-                    it.close()  # stop the stager on early break
-            buf.drain()  # epoch-end fence
-            if tm_on:
-                telemetry.step_end()
+                    if self._auto_plan_pending:
+                        self._auto_plan(first[0], first[1])
+                    step = self._ensure_train()
+                    if not self._graph_linted:
+                        self._graph_linted = True
+                        from ... import analysis
+
+                        # donation advice is noise where _ensure_train
+                        # deliberately disabled it (forced-host CPU mesh)
+                        ignore = (("hbm-undonated-input",)
+                                  if not step.donate_inputs else ())
+                        analysis.autolint(step, (first[0], first[1]),
+                                          enabled=self._graph_lint,
+                                          ignore=ignore)
+                    it = itertools.chain([first], it)
+                skip = start_step if (sess is not None
+                                      and epoch == start_epoch) else 0
+                if skip:
+                    # mid-epoch resume: host RNG was rewound to this epoch's
+                    # start, so the iterator replays the same batch order —
+                    # discard the already-trained prefix host-side
+                    for _ in itertools.islice(it, skip):
+                        pass
+                if prefetch:
+                    it = iter(DeviceLoader(it, buffer_size=prefetch,
+                                           place_fn=self._place_array))
+                if tm_on:
+                    telemetry.step_begin()
+                try:
+                    for i, batch in enumerate(it, start=skip):
+                        if steps_per_epoch is not None and i >= steps_per_epoch:
+                            break
+                        x, y = batch[0], batch[1]
+                        if not prefetch:
+                            x = self._shard_batch(np.asarray(x._value))
+                            y = self._shard_batch(np.asarray(y._value))
+                        loss, out = step(x, y)
+                        buf.append(loss)
+                        if (i + 1) % log_freq == 0:
+                            buf.drain()
+                            if verbose:
+                                print(f"epoch {epoch} step {i}: "
+                                      f"loss {buf.last():.4f}")
+                        if sess is not None:
+                            sess.after_step(epoch, i + 1)
+                        if tm_on:
+                            telemetry.step_begin()  # roll the record over
+                finally:
+                    if hasattr(it, "close"):
+                        it.close()  # stop the stager on early break
+                buf.drain()  # epoch-end fence
+                if tm_on:
+                    telemetry.step_end()
+                if sess is not None:
+                    sess.epoch_end(epoch)
+        finally:
+            if sess is not None:
+                sess.close()
         return {"loss": buf.result()}
 
     def evaluate(self, valid_data, batch_size=1, collate_fn=None, prefetch=2):

@@ -83,10 +83,25 @@ class ElasticManager:
         self.heartbeat()
 
     def heartbeat(self, status="running"):
+        """One keepalive write. Transient registry errors (flaky NFS, a
+        rebinding store) retry with jittered exponential backoff instead of
+        killing the agent's watch loop — losing the heartbeat thread makes
+        every peer see THIS rank as stale and forces a cluster-wide
+        restart, the exact failure the heartbeat exists to prevent."""
+        from ..fault.retry import retry
+
         payload = {"rank": self.rank, "ts": time.time(), "status": status}
         if self._store is not None:
-            self._store.set(f"elastic/rank{self.rank}", json.dumps(payload))
+            from ..core.tcp_store import TCPStoreError
+
+            retry(self._store.set, f"elastic/rank{self.rank}",
+                  json.dumps(payload), tries=4, base_delay=0.1,
+                  retry_on=(OSError, TCPStoreError))
             return
+        retry(self._write_hb_file, payload, tries=4, base_delay=0.1,
+              retry_on=(OSError,))
+
+    def _write_hb_file(self, payload):
         tmp = self._hb_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)

@@ -1,0 +1,41 @@
+"""Fault-tolerance subsystem: durable checkpoints, kill-and-resume, retry
+with backoff, preemption handling, and deterministic fault injection.
+
+Three pillars (see each module):
+
+* :mod:`~paddle_tpu.fault.checkpoint` — atomic, versioned, checksummed
+  ``step_XXXXXXXX/`` checkpoints with ``keep_last_n`` pruning and automatic
+  fallback to the newest verified-good step (``CheckpointManager``);
+* :mod:`~paddle_tpu.fault.state` — full train-state capture/restore
+  (params, optimizer accumulators incl. fp32 master weights, LR scheduler,
+  jax + host RNG, data cursor) and the ``ResumeSession`` driver behind
+  ``hapi.Model.fit(resume=...)`` / ``auto_parallel.Engine.fit(resume=...)``;
+* :mod:`~paddle_tpu.fault.retry` / :mod:`~paddle_tpu.fault.inject` /
+  :mod:`~paddle_tpu.fault.preempt` — jittered exponential backoff for
+  transient I/O, deterministic env/config-driven fault injection
+  (torn-write, worker-death, transient-stage-error, SIGTERM-mid-epoch),
+  and the SIGTERM guard that flushes a final checkpoint before exit.
+
+Inspect checkpoints from the shell with ``tools/ckpt_doctor.py``.
+"""
+from __future__ import annotations
+
+from ..framework.io import CheckpointCorruptError  # noqa: F401
+from .checkpoint import CheckpointManager  # noqa: F401
+from .preempt import PreemptionGuard, TrainingPreempted  # noqa: F401
+from .retry import TransientError, retriable, retry  # noqa: F401
+from .state import ResumeSession, TrainState  # noqa: F401
+from . import inject  # noqa: F401
+
+__all__ = [
+    "CheckpointCorruptError",
+    "CheckpointManager",
+    "PreemptionGuard",
+    "TrainingPreempted",
+    "TransientError",
+    "ResumeSession",
+    "TrainState",
+    "retry",
+    "retriable",
+    "inject",
+]

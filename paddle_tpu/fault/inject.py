@@ -1,0 +1,157 @@
+"""Deterministic fault injection for the fault-tolerance test harness.
+
+Faults are *armed* at named injection points; instrumented code calls
+:func:`check` at its point and the armed fault fires on exactly the
+``at``-th hit — the same arm config always fires at the same place, which
+is what lets the kill-and-resume tests assert bitwise loss parity.
+
+Injection points wired into the runtime:
+
+======================  ======================================================
+point                   instrumented site
+======================  ======================================================
+``ckpt.write``          ``fault.CheckpointManager.save`` — ``torn`` truncates
+                        the payload file just written (simulating a
+                        non-atomic writer dying mid-write)
+``train.step``          ``hapi.Model.fit`` / ``Engine.fit`` resume loop, once
+                        per completed optimizer step — ``sigterm`` raises a
+                        real SIGTERM in-process
+``stage``               ``io.DeviceLoader`` host→device staging — ``error``
+                        raises :class:`~paddle_tpu.fault.retry.TransientError`
+``worker.fetch``        ``io.worker`` process-pool sample fetch — ``kill``
+                        SIGKILLs the worker process
+======================  ======================================================
+
+Arming: programmatic ``arm(kind, point, at=N, once_file=...)`` or the
+``PADDLE_TPU_FAULT_INJECT`` env var (``kind:point:at[:once_file]``,
+comma-separated) — the env form survives ``forkserver`` into DataLoader
+worker processes. ``once_file`` gives cross-process once-only semantics: the
+first process to claim the file (O_EXCL create) fires; respawned workers
+re-hitting the same sample index do not die again.
+
+Kinds: ``sigterm`` | ``kill`` | ``error`` (raised from ``check``) and
+``torn`` (returned from ``check`` for the writer to act on).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from .retry import TransientError
+
+__all__ = ["arm", "disarm_all", "check", "armed", "TransientError",
+           "KINDS", "ENV_VAR"]
+
+ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
+KINDS = ("sigterm", "kill", "error", "torn")
+
+_lock = threading.Lock()
+_armed: list[dict] = []
+_env_loaded = False
+
+
+def _arm_locked(kind, point, at=1, once_file=None):
+    """Append one armed entry; caller holds (or doesn't need) ``_lock``."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+    if at < 1:
+        raise ValueError("at must be >= 1")
+    _armed.append({"kind": kind, "point": point, "at": int(at),
+                   "hits": 0, "fired": False, "once_file": once_file})
+
+
+def _load_env():
+    # caller holds _lock (the lock is not reentrant: never call arm() here)
+    global _env_loaded
+    _env_loaded = True
+    raw = os.environ.get(ENV_VAR, "").strip()
+    if not raw:
+        return
+    for item in raw.split(","):
+        parts = item.strip().split(":", 3)
+        if len(parts) < 3:
+            raise ValueError(
+                f"{ENV_VAR} entry {item!r} must be kind:point:at[:once_file]")
+        kind, point, at = parts[0], parts[1], int(parts[2])
+        once_file = parts[3] if len(parts) > 3 else None
+        _arm_locked(kind, point, at=at, once_file=once_file)
+
+
+def arm(kind, point, at=1, once_file=None):
+    """Arm one fault: fire ``kind`` on the ``at``-th hit of ``point``
+    (1-based) in this process. Each armed entry fires at most once; with
+    ``once_file`` at most once across ALL processes sharing that path."""
+    with _lock:
+        if not _env_loaded:
+            _load_env()
+        _arm_locked(kind, point, at=at, once_file=once_file)
+
+
+def disarm_all():
+    """Clear every armed fault and forget the env config (tests)."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        _env_loaded = True  # explicit reset wins over the env until reload
+
+
+def reload_env():
+    """Re-parse ``PADDLE_TPU_FAULT_INJECT`` (tests that mutate the env)."""
+    global _env_loaded
+    with _lock:
+        _armed.clear()
+        _env_loaded = False
+
+
+def armed():
+    with _lock:
+        if not _env_loaded:
+            _load_env()
+        return [dict(e) for e in _armed]
+
+
+def _claim_once_file(path):
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    os.close(fd)
+    return True
+
+
+def check(point):
+    """Hit ``point`` once. Fires any armed fault whose count comes due:
+    ``sigterm``/``kill``/``error`` act immediately (signal or raise);
+    ``torn`` is returned as the string ``"torn"`` for the caller to corrupt
+    its own output. Returns None when nothing fires — the unarmed path is a
+    single list check."""
+    with _lock:
+        if not _env_loaded:
+            _load_env()
+        if not _armed:
+            return None
+        due = None
+        for e in _armed:
+            if e["fired"] or e["point"] != point:
+                continue
+            e["hits"] += 1
+            if e["hits"] == e["at"]:
+                if e["once_file"] and not _claim_once_file(e["once_file"]):
+                    e["fired"] = True
+                    continue
+                e["fired"] = True
+                due = e
+                break
+        if due is None:
+            return None
+        kind = due["kind"]
+    # act outside the lock: signal handlers / raise paths may re-enter
+    if kind == "sigterm":
+        signal.raise_signal(signal.SIGTERM)
+        return "sigterm"
+    if kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if kind == "error":
+        raise TransientError(f"injected transient error at {point!r}")
+    return "torn"

@@ -1,0 +1,201 @@
+"""Full-state capture/restore for kill-and-resume training.
+
+``TrainState`` snapshots everything a step's result depends on:
+
+* model parameters + buffers (``Layer.state_dict``),
+* optimizer accumulators — including fp32 master weights and the step
+  counter — and the LR-scheduler state (both ride ``Optimizer.state_dict``),
+* the global jax PRNG key (dropout etc.; the compiled step threads it
+  through the state pytree, so the post-step key is the resume point),
+* the host RNG (numpy + python ``random``) as of the *epoch start* — the
+  shuffle permutation of the interrupted epoch is drawn from it, so a
+  mid-epoch resume re-creates the epoch iterator from the same state and
+  replays the identical batch order before skipping the consumed prefix,
+* the data cursor (epoch, steps completed in it, global step).
+
+``ResumeSession`` is the loop-side driver used by ``hapi.Model.fit`` and
+``auto_parallel.Engine.fit``: restore-on-entry, per-step preemption check +
+periodic saves, epoch-end saves, SIGTERM flush. Restored correctly, a run
+killed mid-epoch and resumed reproduces the uninterrupted run's loss
+trajectory bitwise (asserted in ``tests/test_fault_tolerance.py``).
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from .checkpoint import CheckpointManager
+from .preempt import PreemptionGuard, TrainingPreempted
+
+__all__ = ["TrainState", "ResumeSession", "TrainingPreempted"]
+
+
+# -- jax PRNG key (de)serialization -----------------------------------------
+
+def _export_jax_key(key):
+    import jax
+
+    try:
+        if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+            return {"typed": True,
+                    "data": np.asarray(jax.random.key_data(key))}
+    except (AttributeError, TypeError):
+        pass
+    return {"typed": False, "data": np.asarray(key)}
+
+
+def _import_jax_key(rec):
+    import jax
+    import jax.numpy as jnp
+
+    data = jnp.asarray(rec["data"])
+    if rec.get("typed"):
+        return jax.random.wrap_key_data(data)
+    return data
+
+
+class TrainState:
+    """Capture/restore of one (network, optimizer) training pair."""
+
+    @staticmethod
+    def capture(network, optimizer=None):
+        """Payload dict for :meth:`CheckpointManager.save`."""
+        from ..framework.random import get_rng_state
+
+        payloads = {"model": network.state_dict(),
+                    "rng": {"jax": _export_jax_key(get_rng_state())}}
+        if optimizer is not None:
+            payloads["optimizer"] = optimizer.state_dict()
+        return payloads
+
+    @staticmethod
+    def restore(payloads, network, optimizer=None):
+        from ..framework.random import set_rng_state
+
+        network.set_state_dict(payloads["model"])
+        if optimizer is not None and "optimizer" in payloads:
+            optimizer.set_state_dict(payloads["optimizer"])
+        rng = payloads.get("rng") or {}
+        if "jax" in rng:
+            set_rng_state(_import_jax_key(rng["jax"]))
+
+
+def _host_rng_snapshot():
+    return {"np": np.random.get_state(), "py": _pyrandom.getstate()}
+
+
+def _host_rng_restore(snap):
+    if not snap:
+        return
+    if snap.get("np") is not None:
+        np.random.set_state(snap["np"])
+    if snap.get("py") is not None:
+        # pickle round-trips the tuple as nested lists; random wants tuples
+        st = snap["py"]
+        _pyrandom.setstate(tuple(
+            tuple(x) if isinstance(x, list) else x for x in st))
+
+
+class ResumeSession:
+    """Drives checkpoint/resume for one fit run.
+
+    Protocol (the fit loop calls, in order)::
+
+        sess = ResumeSession(resume, network, optimizer, ...)
+        start_epoch, start_step = sess.restore()
+        for epoch in range(start_epoch, epochs):
+            sess.epoch_begin(epoch)
+            skip = start_step if epoch == start_epoch else 0
+            for step in steps(skipping first `skip`):
+                ... run one optimizer step ...
+                sess.after_step(epoch, step + 1)   # may raise TrainingPreempted
+            sess.epoch_end(epoch)
+        sess.close()            # in a finally:
+
+    ``after_step`` polls the SIGTERM guard (and the ``train.step``
+    injection point); on preemption it flushes a consistent checkpoint at
+    the just-completed step boundary and raises :class:`TrainingPreempted`.
+    """
+
+    def __init__(self, resume, network, optimizer=None, keep_last_n=None,
+                 ckpt_freq=None, save_every_epochs=1):
+        self.manager = (resume if isinstance(resume, CheckpointManager)
+                        else CheckpointManager(resume, keep_last_n=keep_last_n))
+        if keep_last_n and not self.manager.keep_last_n:
+            self.manager.keep_last_n = int(keep_last_n)
+        self.network = network
+        self.optimizer = optimizer
+        self.ckpt_freq = int(ckpt_freq) if ckpt_freq else 0
+        self.save_every_epochs = max(0, int(save_every_epochs or 0))
+        self.guard = PreemptionGuard().install()
+        self.global_step = 0
+        self.start_epoch = 0
+        self.start_step = 0
+        self._epoch_host_rng = None
+
+    # -- restore -------------------------------------------------------------
+    def restore(self):
+        """Load the newest verified checkpoint (if any) into the network /
+        optimizer / RNGs and return ``(start_epoch, start_step)`` — the
+        cursor the loop resumes from. Fresh directory: ``(0, 0)``."""
+        try:
+            loaded = self.manager.load()
+        except BaseException:
+            self.close()  # don't leak the SIGTERM handler on a failed start
+            raise
+        if loaded is None:
+            return 0, 0
+        _, payloads = loaded
+        TrainState.restore(payloads, self.network, self.optimizer)
+        cur = payloads.get("cursor") or {}
+        self.start_epoch = int(cur.get("epoch", 0))
+        self.start_step = int(cur.get("step", 0))
+        self.global_step = int(cur.get("global_step", 0))
+        # rewind the host RNG to the cursor epoch's start so the resumed
+        # epoch's shuffle permutation replays identically
+        _host_rng_restore((payloads.get("rng") or {}).get("host_epoch_start"))
+        return self.start_epoch, self.start_step
+
+    # -- loop hooks ----------------------------------------------------------
+    def epoch_begin(self, epoch):
+        # snapshot BEFORE the loader iterator draws the epoch permutation
+        self._epoch_host_rng = _host_rng_snapshot()
+
+    def save(self, epoch, steps_done, at_epoch_end=False):
+        if at_epoch_end:
+            cursor = {"epoch": epoch + 1, "step": 0,
+                      "global_step": self.global_step}
+            host = _host_rng_snapshot()  # state entering the next epoch
+        else:
+            cursor = {"epoch": epoch, "step": steps_done,
+                      "global_step": self.global_step}
+            host = self._epoch_host_rng or _host_rng_snapshot()
+        payloads = TrainState.capture(self.network, self.optimizer)
+        payloads["cursor"] = cursor
+        payloads["rng"]["host_epoch_start"] = host
+        return self.manager.save(self.global_step, payloads)
+
+    def after_step(self, epoch, steps_done):
+        """Call once per completed optimizer step with the count of steps
+        done in this epoch. Periodic save per ``ckpt_freq``; on SIGTERM,
+        flush and raise :class:`TrainingPreempted`."""
+        from . import inject
+
+        self.global_step += 1
+        inject.check("train.step")
+        preempted = self.guard.preempted
+        if preempted or (self.ckpt_freq
+                         and steps_done % self.ckpt_freq == 0):
+            self.save(epoch, steps_done)
+        if preempted:
+            raise TrainingPreempted(
+                f"SIGTERM at epoch {epoch} step {steps_done}: checkpoint "
+                f"flushed to {self.manager.root!r}", step=self.global_step)
+
+    def epoch_end(self, epoch):
+        if self.save_every_epochs and (epoch + 1) % self.save_every_epochs == 0:
+            self.save(epoch, 0, at_epoch_end=True)
+
+    def close(self):
+        self.guard.uninstall()

@@ -1,20 +1,74 @@
 """paddle.save / paddle.load (reference ``python/paddle/framework/io.py:574/791``:
 pickled state_dict with tensors converted to numpy).
 
+Durability: ``save`` is ATOMIC — the pickle lands in a same-directory temp
+file which is fsynced and ``os.replace``d over the destination, so a crash
+mid-write can never leave a torn ``.pdparams`` behind (readers see either
+the old file or the new one, never a prefix). ``load`` wraps truncated /
+garbage files in :class:`CheckpointCorruptError` carrying the path and the
+underlying cause, so callers (``paddle_tpu.fault.CheckpointManager``) can
+distinguish "corrupt checkpoint, try the previous one" from real bugs.
+
 Sharded / resharding-aware distributed checkpoints live in
 ``paddle_tpu.distributed.checkpoint`` (orbax-backed)."""
 from __future__ import annotations
 
 import os
 import pickle
+import tempfile
 
 import numpy as np
 
 from .tensor import Tensor
 
-__all__ = ["save", "load"]
+__all__ = ["save", "load", "CheckpointCorruptError", "atomic_write"]
 
 _PROTO = 4
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file is truncated, garbage, or fails its checksum.
+
+    Carries ``path`` and (when available) the underlying decode error as
+    ``__cause__`` so recovery code can report exactly what was lost."""
+
+    def __init__(self, path, reason=""):
+        self.path = str(path)
+        msg = f"corrupt checkpoint file {self.path!r}"
+        if reason:
+            msg += f": {reason}"
+        super().__init__(msg)
+
+
+def atomic_write(path, write_fn, fsync_parent=True):
+    """Write ``path`` atomically: ``write_fn(file)`` into a same-directory
+    temp file, flush + fsync, then ``os.replace`` over the destination.
+    ``fsync_parent`` additionally fsyncs the directory so the rename itself
+    is durable (a crash cannot resurrect the old name pointing nowhere)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync_parent:
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; rename still atomic
 
 
 def _to_serializable(obj):
@@ -51,14 +105,17 @@ def _from_serializable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=_PROTO, **configs):
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    data = _to_serializable(obj)
+    atomic_write(path, lambda f: pickle.dump(data, f, protocol=protocol))
 
 
 def load(path, return_numpy=False, **configs):
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    except (pickle.UnpicklingError, EOFError, ValueError, AttributeError,
+            ImportError, IndexError, MemoryError) as e:
+        # truncated pickles surface as EOFError/UnpicklingError; bit flips
+        # as almost anything the pickle VM can raise
+        raise CheckpointCorruptError(path, f"{type(e).__name__}: {e}") from e
     return _from_serializable(obj, return_numpy=return_numpy)

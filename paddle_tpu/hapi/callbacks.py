@@ -176,24 +176,70 @@ class ProgBarLogger(Callback):
 
 class ModelCheckpoint(Callback):
     """Reference ``callbacks.py:534`` — save every ``save_freq`` epochs +
-    final."""
+    final.
 
-    def __init__(self, save_freq=1, save_dir=None):
+    When the last epoch was already saved by ``save_freq``, ``final`` is
+    not re-serialized (a second full write of the same state): it is
+    hardlinked (copy fallback) to that epoch's files. ``keep_last_n``
+    prunes older per-epoch checkpoints, delegated to
+    ``fault.CheckpointManager.prune_flat``; ``final`` survives pruning."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_last_n=None):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last_n = keep_last_n
+        self._saved_epochs = []
+        self._last_epoch = None
 
     def on_epoch_end(self, epoch, logs=None):
+        self._last_epoch = epoch
         if self.save_dir and epoch % self.save_freq == 0:
             path = os.path.join(self.save_dir, str(epoch))
             print(f"save checkpoint at {os.path.abspath(path)}")
             self.model.save(path)
+            self._saved_epochs.append(epoch)
+            if self.keep_last_n:
+                from ..fault import CheckpointManager
+
+                pruned = CheckpointManager.prune_flat(
+                    self.save_dir, self._saved_epochs, self.keep_last_n)
+                self._saved_epochs = [e for e in self._saved_epochs
+                                      if e not in pruned]
+
+    def _alias_final(self, epoch):
+        """Point ``final.*`` at epoch ``epoch``'s files without rewriting
+        the checkpoint (hardlink; copy when linking is unsupported)."""
+        import shutil
+
+        for ext in (".pdparams", ".pdopt"):
+            src = os.path.join(self.save_dir, str(epoch) + ext)
+            dst = os.path.join(self.save_dir, "final" + ext)
+            if not os.path.exists(src):
+                continue
+            try:
+                os.remove(dst)
+            except OSError:
+                pass
+            try:
+                os.link(src, dst)
+            except OSError:
+                shutil.copyfile(src, dst)
 
     def on_train_end(self, logs=None):
-        if self.save_dir:
-            path = os.path.join(self.save_dir, "final")
-            print(f"save checkpoint at {os.path.abspath(path)}")
-            self.model.save(path)
+        if not self.save_dir:
+            return
+        path = os.path.join(self.save_dir, "final")
+        if self._last_epoch is not None and self._saved_epochs \
+                and self._saved_epochs[-1] == self._last_epoch:
+            # the last epoch's checkpoint IS the final state: alias it
+            # instead of serializing the whole model a second time
+            print(f"alias final checkpoint -> epoch {self._last_epoch} "
+                  f"at {os.path.abspath(path)}")
+            self._alias_final(self._last_epoch)
+            return
+        print(f"save checkpoint at {os.path.abspath(path)}")
+        self.model.save(path)
 
 
 class LRScheduler(Callback):

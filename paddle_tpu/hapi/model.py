@@ -244,8 +244,30 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            resume=None, ckpt_freq=None, keep_last_n=None):
+        """Train. ``resume`` (a directory path or a
+        ``fault.CheckpointManager``) makes the run fault-tolerant: the
+        newest verified checkpoint there is restored (params, optimizer
+        accumulators incl. master weights, LR scheduler, RNG, data cursor)
+        and training continues from the exact step it stopped at; a
+        SIGTERM mid-run flushes a consistent checkpoint and raises
+        ``fault.TrainingPreempted``. Checkpoints are written every epoch
+        plus every ``ckpt_freq`` steps; ``keep_last_n`` bounds how many are
+        kept."""
         assert train_data is not None, "train_data must be given!"
+        sess = None
+        start_epoch = start_step = 0
+        if resume is not None:
+            from ..fault import ResumeSession
+
+            sess = ResumeSession(resume, self.network, self._optimizer,
+                                 keep_last_n=keep_last_n, ckpt_freq=ckpt_freq)
+            start_epoch, start_step = sess.restore()
+            # compiled steps bake the state pytree: rebuild on restored state
+            self._train_step = None
+            self._eval_step = None
+            self._pred_step = None
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last)
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
@@ -256,19 +278,33 @@ class Model:
                                 verbose=verbose,
                                 metrics=["loss"] + self._metrics_name())
         self.stop_training = False
+        logs = {}
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(loader, cbks, "train", log_freq)
-            if eval_loader is not None and epoch % eval_freq == 0:
-                cbks.on_begin("eval")
-                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval",
-                                                log_freq)
-                cbks.on_end("eval", eval_logs)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            cbks.on_epoch_end(epoch, logs)
-            if self.stop_training:
-                break
+        try:
+            for epoch in range(start_epoch, epochs):
+                if sess is not None:
+                    # host-RNG snapshot BEFORE the epoch permutation draws
+                    sess.epoch_begin(epoch)
+                cbks.on_epoch_begin(epoch)
+                skip = start_step if (sess is not None
+                                      and epoch == start_epoch) else 0
+                logs = self._run_one_epoch(loader, cbks, "train", log_freq,
+                                           skip_steps=skip, fault_sess=sess,
+                                           epoch=epoch)
+                if eval_loader is not None and epoch % eval_freq == 0:
+                    cbks.on_begin("eval")
+                    eval_logs = self._run_one_epoch(eval_loader, cbks, "eval",
+                                                    log_freq)
+                    cbks.on_end("eval", eval_logs)
+                    logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbks.on_epoch_end(epoch, logs)
+                if sess is not None:
+                    sess.epoch_end(epoch)
+                if self.stop_training:
+                    break
+        finally:
+            if sess is not None:
+                sess.close()
         cbks.on_end("train", logs)
         return logs
 
@@ -316,7 +352,10 @@ class Model:
             names.extend(n if isinstance(n, (list, tuple)) else [n])
         return names
 
-    def _run_one_epoch(self, loader, cbks, mode, log_freq=10):
+    def _run_one_epoch(self, loader, cbks, mode, log_freq=10, skip_steps=0,
+                       fault_sess=None, epoch=0):
+        import itertools
+
         from ..io.device_loader import DeviceLoader
         from ..metric import AsyncMetricBuffer
         from ..profiler import telemetry
@@ -330,6 +369,14 @@ class Model:
         # boundaries + epoch end (metric.AsyncMetricBuffer)
         buf = AsyncMetricBuffer()
         log_freq = max(1, int(log_freq or 1))
+        src = iter(loader)
+        if skip_steps:
+            # mid-epoch resume: the host RNG was rewound to this epoch's
+            # start, so this iterator replays the interrupted epoch's exact
+            # batch order — discard the already-trained prefix on the host
+            # (the device never sees the skipped batches)
+            for _ in itertools.islice(src, skip_steps):
+                pass
         # per-step phase timeline: the flag is global and False by default,
         # so the disabled path does zero telemetry work. step_begin sits
         # BEFORE the for statement (and again at each body end) because the
@@ -338,7 +385,7 @@ class Model:
         tm_on = telemetry.enabled()
         if tm_on:
             telemetry.step_begin()
-        for step, batch in enumerate(DeviceLoader(loader)):
+        for step, batch in enumerate(DeviceLoader(src), start=skip_steps):
             batch = _to_list(batch)
             # convention: trailing element(s) are labels when a loss is set
             if self._loss is not None and len(batch) >= 2:
@@ -351,10 +398,10 @@ class Model:
             else:
                 loss, outs, labs = self._eval_batch_device(ins, labs)
             buf.append(loss)
-            # fence at log_freq boundaries; also once at step 0 so
+            # fence at log_freq boundaries; also once at the first step so
             # logs['loss'] exists from the first callback onward (between
             # fences it holds the last drained value)
-            if step == 0 or (step + 1) % log_freq == 0:
+            if step == skip_steps or (step + 1) % log_freq == 0:
                 buf.drain()  # fence: flush pending device losses to host
             if buf.values:
                 logs["loss"] = buf.last()
@@ -369,6 +416,11 @@ class Model:
             bs = ins[0].shape[0] if hasattr(ins[0], "shape") else len(ins[0])
             total_samples += bs
             cbks.on_batch_end(mode, step, logs)
+            if fault_sess is not None and mode == "train":
+                # AFTER on_batch_end: the LRScheduler callback has stepped,
+                # so a checkpoint here captures the post-step boundary
+                # exactly; raises TrainingPreempted after a SIGTERM flush
+                fault_sess.after_step(epoch, step + 1)
             if tm_on:
                 telemetry.step_begin()  # roll the phase record over
         buf.drain()  # epoch-end fence

@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 
 __all__ = ["register", "train_epoch_range", "reset"]
 
@@ -53,19 +52,25 @@ def _marker_path(save_dir):
 
 
 def _save(save_dir, epoch):
-    from ...framework.io import save as psave
+    from ...framework.io import atomic_write, save as psave
 
     os.makedirs(save_dir, exist_ok=True)
+    # state files FIRST — each atomic (tmp+fsync+replace, framework.io) —
+    # and only then the marker, also atomic + fsynced: the marker can never
+    # name an epoch whose state files are missing or partial, and a crash
+    # anywhere leaves the previous epoch resumable (the reference's
+    # checkpoint epoch ordering)
+    state_files = []
     for i, l in enumerate(_registered["layers"]):
-        psave(l.state_dict(), os.path.join(save_dir, f"layer{i}.pdparams"))
+        state_files.append(os.path.join(save_dir, f"layer{i}.pdparams"))
+        psave(l.state_dict(), state_files[-1])
     for i, o in enumerate(_registered["optimizers"]):
-        psave(o.state_dict(), os.path.join(save_dir, f"opt{i}.pdopt"))
-    # write the marker last and atomically: a crash mid-save must leave the
-    # previous epoch resumable (the reference's checkpoint epoch ordering)
-    fd, tmp = tempfile.mkstemp(dir=save_dir)
-    with os.fdopen(fd, "w") as f:
-        json.dump({"epoch": epoch}, f)
-    os.replace(tmp, _marker_path(save_dir))
+        state_files.append(os.path.join(save_dir, f"opt{i}.pdopt"))
+        psave(o.state_dict(), state_files[-1])
+    marker = {"epoch": epoch,
+              "state_files": [os.path.basename(p) for p in state_files]}
+    atomic_write(_marker_path(save_dir),
+                 lambda f: f.write(json.dumps(marker).encode()))
 
 
 def _restore(save_dir):

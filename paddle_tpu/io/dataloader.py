@@ -83,6 +83,7 @@ class DataLoader:
         worker_init_fn=None,
         persistent_workers=False,
         use_process=False,
+        worker_restart_limit=2,
     ):
         self.dataset = dataset
         self.return_list = return_list
@@ -91,6 +92,11 @@ class DataLoader:
         self.prefetch_factor = max(2, prefetch_factor)
         self.worker_init_fn = worker_init_fn
         self.use_process = bool(use_process)
+        # process-mode fault tolerance: a worker killed by SIGKILL/segfault
+        # is respawned (with backoff) and its in-flight batches re-dispatched
+        # up to this many times per pool before WorkerFailure surfaces;
+        # worker EXCEPTIONS (user-code bugs) always propagate immediately
+        self.worker_restart_limit = max(0, int(worker_restart_limit or 0))
         self.use_shared_memory = bool(use_shared_memory)
         self.persistent_workers = bool(persistent_workers)
         self.timeout = timeout

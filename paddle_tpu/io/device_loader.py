@@ -93,6 +93,9 @@ class DeviceLoader:
         return leaf
 
     def _stage(self, batch):
+        from ..fault import inject
+
+        inject.check("stage")  # transient-stage-error injection point
         # Tensors are opaque to tree_flatten, so they arrive here as leaves
         if not _telemetry.enabled():
             return jax.tree_util.tree_map(self._stage_leaf, batch)
@@ -139,6 +142,8 @@ class DeviceLoader:
         return False
 
     def _run(self, it, out_q, done):
+        from ..fault.retry import retry
+
         try:
             while not done.is_set():
                 try:
@@ -146,7 +151,11 @@ class DeviceLoader:
                 except StopIteration:
                     break
                 try:
-                    staged = self._stage(batch)
+                    # transient staging failures (flaky device tunnel,
+                    # injected TransientError) retry with jittered backoff;
+                    # anything non-OSError surfaces on the first raise
+                    staged = retry(self._stage, batch, tries=3,
+                                   base_delay=0.02, retry_on=(OSError,))
                 except BaseException as e:
                     self._put(out_q, done, _StageError(e))
                     return

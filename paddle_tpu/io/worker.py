@@ -44,6 +44,14 @@ class WorkerFailure(RuntimeError):
     """A worker raised (carries its traceback) or died (SIGKILL/segfault)."""
 
 
+class _WorkersDied(Exception):
+    """Internal control flow: dead worker slots that may still be restarted
+    (``DataLoader(worker_restart_limit=...)``)."""
+
+    def __init__(self, slots):
+        self.slots = slots
+
+
 # -- batch <-> shared memory ------------------------------------------------
 
 def _flatten(obj, arrays, spec):
@@ -160,6 +168,9 @@ def _worker_loop(wid, num_workers, dataset, collate_fn, task_q, result_q,
                 return
             epoch, idx, indices = msg
             try:
+                from ..fault import inject
+
+                inject.check("worker.fetch")  # deterministic worker-death
                 batch = collate_fn([dataset[i] for i in indices])
                 payload = (_encode_shm(batch) if use_shared_memory
                            else {"shm": None, "pickled": True,
@@ -217,6 +228,9 @@ class ProcessPool:
         self._nw = loader.num_workers
         self._iterable = iterable_cfg is not None
         self._timeout = float(getattr(loader, "timeout", 0) or 0)
+        self._restart_limit = int(
+            getattr(loader, "worker_restart_limit", 0) or 0)
+        self._restarts_used = 0
         self._task_q = ctx.Queue()
         # bounded: back-pressure for iterable-mode workers (map-style is
         # already bounded by task issuance, which never exceeds this)
@@ -225,35 +239,60 @@ class ProcessPool:
         self._epoch = 0
         self._busy = False   # one live iterator at a time (epoch tags)
         base_seed = int.from_bytes(os.urandom(4), "little")
-        self._procs = [
-            ctx.Process(
-                target=_worker_loop,
-                args=(w, self._nw, loader.dataset, loader.collate_fn,
+        # capture spawn args (not the loader: its __del__ owns this pool)
+        spawn_args = (self._nw, loader.dataset, loader.collate_fn,
                       self._task_q, self._result_q, loader.worker_init_fn,
-                      loader.use_shared_memory, iterable_cfg, base_seed),
-                daemon=True,
-            )
-            for w in range(self._nw)
-        ]
+                      loader.use_shared_memory, iterable_cfg, base_seed)
+        self._spawn = lambda w: ctx.Process(
+            target=_worker_loop, args=(w,) + spawn_args, daemon=True)
+        self._procs = [self._spawn(w) for w in range(self._nw)]
         for p in self._procs:
             p.start()
 
-    def _check_alive(self):
-        dead = [p.pid for p in self._procs if not p.is_alive()]
-        if dead:
-            raise WorkerFailure(
-                f"DataLoader worker (pid {dead}) exited unexpectedly — "
-                "killed or crashed; see worker stderr"
-            )
+    def _check_alive(self, restartable=False):
+        dead = [i for i, p in enumerate(self._procs) if not p.is_alive()]
+        if not dead:
+            return
+        if restartable and self._restarts_used < self._restart_limit:
+            raise _WorkersDied(dead)
+        pids = [self._procs[i].pid for i in dead]
+        raise WorkerFailure(
+            f"DataLoader worker (pid {pids}) exited unexpectedly — "
+            "killed or crashed; see worker stderr"
+            + (f" ({self._restarts_used} restarts already used)"
+               if self._restarts_used else "")
+        )
 
-    def _poll(self):
+    def _restart_workers(self, slots):
+        """Respawn dead worker slots with exponential backoff + jitter.
+        Map-style recovery path: the caller re-dispatches in-flight tasks;
+        duplicate results are dropped by index."""
+        import random as _random
+        import time as _time
+
+        self._restarts_used += 1
+        delay = min(0.05 * (2 ** (self._restarts_used - 1)), 2.0)
+        _time.sleep(delay * (1.0 + 0.5 * _random.random()))
+        for w in slots:
+            try:
+                self._procs[w].join(timeout=0.1)
+            except Exception:
+                pass
+            self._procs[w] = self._spawn(w)
+            self._procs[w].start()
+        from ..profiler import telemetry
+
+        if telemetry.enabled():
+            telemetry.get_telemetry().inc("fault.worker_restarts", len(slots))
+
+    def _poll(self, restartable=False):
         """One result, liveness-checked; honors the DataLoader timeout."""
         waited = 0.0
         while True:
             try:
                 return self._result_q.get(timeout=1.0)
             except _queue.Empty:
-                self._check_alive()
+                self._check_alive(restartable)
                 waited += 1.0
                 if self._timeout and waited >= self._timeout:
                     raise WorkerFailure(
@@ -281,25 +320,48 @@ class ProcessPool:
     # -- map-style epochs ---------------------------------------------------
     def run_epoch(self, batches, capacity):
         """Yield collated batches in order, issuing at most ``capacity``
-        in-flight tasks."""
+        in-flight tasks.
+
+        A worker death (SIGKILL/segfault) is survivable: up to
+        ``worker_restart_limit`` times the pool respawns the dead slots and
+        re-dispatches every in-flight index — a task the dead worker had
+        claimed would otherwise never produce its batch. Re-dispatch can
+        duplicate work still owned by a live worker; duplicate results are
+        dropped by batch index. Worker EXCEPTIONS (user-code bugs) are not
+        retried — they propagate immediately via ``WorkerFailure``."""
         self._epoch += 1
         epoch = self._epoch
         n = len(batches)
         capacity = min(capacity, self._capacity)
         next_task = 0
         buf = {}
+        in_flight = {}  # idx -> sample indices, issued but not received
+
+        def issue(i):
+            self._task_q.put((epoch, i, batches[i]))
+            in_flight[i] = batches[i]
+
         for _ in range(min(capacity, n)):
-            self._task_q.put((epoch, next_task, batches[next_task]))
+            issue(next_task)
             next_task += 1
         for want in range(n):
             while want not in buf:
-                out = self._handle(self._poll(), epoch)
+                try:
+                    out = self._handle(self._poll(restartable=True), epoch)
+                except _WorkersDied as dead:
+                    self._restart_workers(dead.slots)
+                    for i, idxs in list(in_flight.items()):
+                        self._task_q.put((epoch, i, idxs))
+                    continue
                 if out is None:
                     continue
                 _, idx, batch = out
+                in_flight.pop(idx, None)
+                if idx < want or idx in buf:
+                    continue  # duplicate from a re-dispatch
                 buf[idx] = batch
             if next_task < n:
-                self._task_q.put((epoch, next_task, batches[next_task]))
+                issue(next_task)
                 next_task += 1
             yield buf.pop(want)
 

@@ -1,0 +1,537 @@
+"""Fault tolerance (paddle_tpu.fault): atomic checkpoint/resume, preemption
+handling, retry with backoff, worker restart, deterministic fault injection.
+
+The headline contracts (ISSUE 4 acceptance):
+
+* a run killed by an injected SIGTERM mid-epoch and restarted with
+  ``Model.fit(resume=...)`` reproduces the uninterrupted run's loss
+  trajectory BITWISE (SGD with shuffle on, and Adam with fp32 master
+  weights);
+* an injected torn write on the newest checkpoint is caught by the
+  manifest CRC32 and ``CheckpointManager.load`` falls back to the previous
+  verified-good step.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.io import CheckpointCorruptError, load as pload, \
+    save as psave
+from paddle_tpu.fault import (CheckpointManager, PreemptionGuard,
+                              TrainingPreempted, TransientError, inject,
+                              retry)
+from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.device_loader import DeviceLoader
+from paddle_tpu.io.worker import WorkerFailure
+from paddle_tpu.nn import CrossEntropyLoss
+from paddle_tpu.utils import unique_name
+
+
+@pytest.fixture(autouse=True)
+def _clean_injection(monkeypatch):
+    # fork-start for the worker tests (forkserver costs ~10s/pool) and a
+    # guaranteed-disarmed injection registry around every test
+    monkeypatch.setenv("PADDLE_TPU_WORKER_START", "fork")
+    inject.disarm_all()
+    yield
+    inject.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# framework.io atomicity + corruption detection
+# ---------------------------------------------------------------------------
+
+def test_save_is_atomic_and_roundtrips(tmp_path):
+    path = str(tmp_path / "sub" / "state.pdparams")
+    psave({"w": paddle.to_tensor(np.arange(6, dtype=np.float32))}, path)
+    # no temp litter next to the file
+    assert os.listdir(os.path.dirname(path)) == ["state.pdparams"]
+    out = pload(path, return_numpy=True)
+    np.testing.assert_array_equal(out["w"], np.arange(6, dtype=np.float32))
+
+
+def test_load_truncated_raises_corrupt_error(tmp_path):
+    path = str(tmp_path / "t.pdparams")
+    psave({"w": np.arange(1024, dtype=np.float32)}, path)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorruptError, match="t.pdparams"):
+        pload(path)
+
+
+def test_load_garbage_raises_corrupt_error(tmp_path):
+    path = str(tmp_path / "g.pdparams")
+    with open(path, "wb") as f:
+        f.write(b"\x80\x04this is not a pickle at all")
+    with pytest.raises(CheckpointCorruptError) as ei:
+        pload(path)
+    assert ei.value.path == path
+    assert ei.value.__cause__ is not None
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: versioning, pruning, torn-write fallback
+# ---------------------------------------------------------------------------
+
+def test_manager_roundtrip_latest_pointer_and_pruning(tmp_path):
+    m = CheckpointManager(str(tmp_path / "ck"), keep_last_n=3)
+    for s in range(1, 6):
+        m.save(s, {"model": {"w": np.full(4, float(s), np.float32)},
+                   "cursor": {"epoch": s}})
+    assert m.steps() == [3, 4, 5]          # keep_last_n pruned 1, 2
+    assert m.latest_step() == 5
+    step, payloads = m.load()
+    assert step == 5
+    np.testing.assert_array_equal(
+        np.asarray(payloads["model"]["w"]._value), np.full(4, 5.0))
+    assert payloads["cursor"]["epoch"] == 5
+    assert m.verify(4) == []
+
+
+def test_manager_torn_write_detected_and_falls_back(tmp_path):
+    from paddle_tpu.profiler import telemetry
+
+    m = CheckpointManager(str(tmp_path / "ck"))
+    m.save(7, {"model": {"w": np.zeros(64, np.float32)}})
+    inject.arm("torn", "ckpt.write", at=1)
+    m.save(8, {"model": {"w": np.ones(64, np.float32)}})
+    assert m.verify(8), "torn write must fail verification"
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        with pytest.warns(UserWarning, match="recovered from corrupt"):
+            step, payloads = m.load()
+        assert step == 7
+        assert telemetry.get_telemetry().counters()[
+            "fault.ckpt_recoveries"] == 1
+    finally:
+        telemetry.disable()
+
+
+def test_manager_all_corrupt_raises(tmp_path):
+    m = CheckpointManager(str(tmp_path / "ck"))
+    m.save(1, {"model": {"w": np.ones(16, np.float32)}})
+    with open(os.path.join(m.step_dir(1), "model.pdparams"), "r+b") as f:
+        f.truncate(4)
+    with pytest.warns(UserWarning):
+        with pytest.raises(CheckpointCorruptError, match="no verifiable"):
+            m.load()
+
+
+def test_manager_empty_dir_returns_none(tmp_path):
+    assert CheckpointManager(str(tmp_path / "nothing")).load() is None
+
+
+# ---------------------------------------------------------------------------
+# retry + injection determinism
+# ---------------------------------------------------------------------------
+
+def test_retry_backoff_grows_then_succeeds():
+    sleeps, state = [], {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return 42
+
+    assert retry(flaky, tries=4, base_delay=0.1, jitter=0.0,
+                 sleep=sleeps.append) == 42
+    assert sleeps == [0.1, 0.2]  # exponential, no jitter
+
+
+def test_retry_gives_up_and_nonretryable_propagates():
+    sleeps = []
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry(always, tries=3, base_delay=0.01, sleep=sleeps.append)
+    assert len(sleeps) == 2
+
+    def bug():
+        raise ValueError("user bug")
+
+    with pytest.raises(ValueError):
+        retry(bug, tries=3, base_delay=0.01, sleep=sleeps.append)
+    assert len(sleeps) == 2  # no extra sleeps: not retried
+
+
+def test_injection_fires_deterministically():
+    for _ in range(2):  # same arm config -> same fire point, every time
+        inject.disarm_all()
+        inject.arm("error", "stage", at=3)
+        fired = []
+        for i in range(6):
+            try:
+                inject.check("stage")
+            except TransientError:
+                fired.append(i)
+        assert fired == [2]  # 3rd hit, exactly once
+
+
+def test_injection_env_parsing(monkeypatch):
+    monkeypatch.setenv(inject.ENV_VAR, "error:stage:2,torn:ckpt.write:1:/x/y")
+    inject.reload_env()
+    entries = inject.armed()
+    assert [(e["kind"], e["point"], e["at"]) for e in entries] == \
+        [("error", "stage", 2), ("torn", "ckpt.write", 1)]
+    assert entries[1]["once_file"] == "/x/y"
+    assert inject.check("stage") is None
+    with pytest.raises(TransientError):
+        inject.check("stage")
+
+
+def test_preemption_guard_latches_and_restores_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as g:
+        assert not g.preempted
+        signal.raise_signal(signal.SIGTERM)
+        assert g.preempted
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+# ---------------------------------------------------------------------------
+# DeviceLoader transient-stage retry + elastic heartbeat retry
+# ---------------------------------------------------------------------------
+
+def test_device_loader_retries_transient_stage_error():
+    inject.arm("error", "stage", at=2)
+    batches = [(np.full((2, 2), i, np.float32),) for i in range(4)]
+    out = list(DeviceLoader(batches))
+    assert len(out) == 4  # the injected failure was absorbed by retry
+    np.testing.assert_array_equal(np.asarray(out[1][0]), np.ones((2, 2)))
+
+
+def test_device_loader_nontransient_stage_error_propagates():
+    def batches():
+        yield (np.ones(2, np.float32),)
+        raise ValueError("source bug")
+
+    with pytest.raises(ValueError, match="source bug"):
+        list(DeviceLoader(batches()))
+
+
+def test_elastic_heartbeat_retries_transient_fs_errors(tmp_path, monkeypatch):
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    em = ElasticManager(elastic_dir=str(tmp_path), rank=0, world_size=1)
+    real_replace = os.replace
+    state = {"fails": 2}
+
+    def flaky(src, dst):
+        if dst.endswith("rank0.json") and state["fails"] > 0:
+            state["fails"] -= 1
+            raise OSError("EIO: flaky NFS")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", flaky)
+    em.heartbeat()  # would raise without retry
+    assert state["fails"] == 0
+    assert em.world() == [0]
+
+
+# ---------------------------------------------------------------------------
+# worker death: restart + re-dispatch
+# ---------------------------------------------------------------------------
+
+class ArrayDS(Dataset):
+    def __init__(self, n=64):
+        self.x = np.arange(n, dtype=np.float32)
+
+    def __getitem__(self, i):
+        return (self.x[i],)
+
+    def __len__(self):
+        return len(self.x)
+
+
+class BoomDS(ArrayDS):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("boom at 13")
+        return super().__getitem__(i)
+
+
+def _collect_samples(loader):
+    return sorted(float(v) for b in loader for v in np.asarray(b[0]).ravel())
+
+
+def test_killed_worker_restarts_and_epoch_completes(tmp_path, monkeypatch):
+    once = str(tmp_path / "kill_once")
+    monkeypatch.setenv(inject.ENV_VAR, f"kill:worker.fetch:2:{once}")
+    inject.reload_env()  # forked workers inherit the un-loaded registry
+    loader = DataLoader(ArrayDS(), batch_size=4, num_workers=2,
+                        use_process=True, worker_restart_limit=2)
+    got = _collect_samples(loader)
+    assert got == [float(i) for i in range(64)]  # every sample exactly once
+    assert os.path.exists(once)  # the kill really fired
+
+
+def test_killed_worker_fails_fast_without_restart_budget(tmp_path,
+                                                         monkeypatch):
+    once = str(tmp_path / "kill_once0")
+    monkeypatch.setenv(inject.ENV_VAR, f"kill:worker.fetch:2:{once}")
+    inject.reload_env()
+    loader = DataLoader(ArrayDS(), batch_size=4, num_workers=2,
+                        use_process=True, worker_restart_limit=0)
+    with pytest.raises(WorkerFailure, match="exited unexpectedly"):
+        list(loader)
+
+
+def test_worker_exception_propagates_immediately_despite_restart_budget():
+    loader = DataLoader(BoomDS(), batch_size=4, num_workers=2,
+                        use_process=True, worker_restart_limit=5)
+    with pytest.raises(WorkerFailure, match="boom at 13"):
+        list(loader)
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume: bitwise loss parity (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _no_persistent_compile_cache():
+    """Bitwise parity needs the reference and the resumed run to execute the
+    SAME binary. Executables round-tripped through the persistent XLA:CPU
+    compile cache are NOT bit-identical to fresh in-process compiles on this
+    stack (measured: warm-cache runs diverge in the last fp16 ulp a few
+    steps after any compile boundary; cold-cache and cache-off runs agree
+    exactly) — so the parity tests compile everything in-process."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+class ToyClassify(Dataset):
+    def __init__(self, n=48, seed=0, dtype=np.float32):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 8).astype(dtype)
+        w = rng.randn(8).astype(np.float32)
+        self.y = (self.x.astype(np.float32) @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class LossRecorder(Callback):
+    def __init__(self):
+        self.losses = []
+
+    def on_train_batch_end(self, step, logs=None):
+        self.losses.append(logs["loss"])
+
+
+def _make_model(optimizer, dtype=None):
+    with unique_name.guard():
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 2))
+    if dtype:
+        net.to(dtype=dtype)
+    model = paddle.Model(net)
+    opt = optimizer(net)
+    model.prepare(opt, CrossEntropyLoss())
+    return model
+
+
+def _parity_run(tmp_path, optimizer, *, shuffle, dtype=None, kill_at=8):
+    """Uninterrupted run vs (SIGTERM-killed + resumed) run — losses must be
+    bitwise identical, step for step."""
+    data = lambda: ToyClassify(dtype=dtype or np.float32)  # noqa: E731
+    fit_kw = dict(batch_size=8, epochs=2, verbose=0, shuffle=shuffle,
+                  log_freq=1)
+
+    np.random.seed(1234)
+    ref = LossRecorder()
+    _make_model(optimizer, dtype).fit(data(), callbacks=[ref], **fit_kw)
+
+    ck = str(tmp_path / "resume_ck")
+    np.random.seed(1234)
+    part1 = LossRecorder()
+    inject.arm("sigterm", "train.step", at=kill_at)
+    with pytest.raises(TrainingPreempted):
+        _make_model(optimizer, dtype).fit(data(), callbacks=[part1],
+                                          resume=ck, **fit_kw)
+    inject.disarm_all()
+    assert len(part1.losses) == kill_at
+    # fresh process stand-in: a brand-new model/optimizer, state from disk
+    part2 = LossRecorder()
+    _make_model(optimizer, dtype).fit(data(), callbacks=[part2],
+                                      resume=ck, **fit_kw)
+    resumed = part1.losses + part2.losses
+    assert len(resumed) == len(ref.losses)
+    assert resumed == ref.losses  # BITWISE: float equality, no tolerance
+
+
+def test_kill_and_resume_loss_parity_sgd_shuffled(tmp_path, _no_persistent_compile_cache):
+    _parity_run(
+        tmp_path,
+        lambda net: paddle.optimizer.SGD(learning_rate=0.1,
+                                         parameters=net.parameters()),
+        shuffle=True)
+
+
+def test_kill_and_resume_loss_parity_adam_master_weights(tmp_path, _no_persistent_compile_cache):
+    _parity_run(
+        tmp_path,
+        lambda net: paddle.optimizer.Adam(learning_rate=0.05,
+                                          parameters=net.parameters(),
+                                          multi_precision=True),
+        shuffle=False, dtype="float16", kill_at=7)
+
+
+def test_fit_resume_writes_epoch_and_periodic_checkpoints(tmp_path):
+    ck = str(tmp_path / "ck")
+    model = _make_model(lambda net: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    model.fit(ToyClassify(), batch_size=8, epochs=2, verbose=0,
+              shuffle=False, resume=ck, ckpt_freq=2, keep_last_n=3)
+    mgr = CheckpointManager(ck)
+    steps = mgr.steps()
+    assert steps, "resume-enabled fit must leave checkpoints behind"
+    assert len(steps) <= 3  # keep_last_n enforced
+    # cursor of the newest checkpoint points past the last epoch
+    _, payloads = mgr.load()
+    assert payloads["cursor"]["epoch"] == 2
+    # resuming a completed run is a no-op (no steps to execute)
+    again = LossRecorder()
+    model2 = _make_model(lambda net: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    model2.fit(ToyClassify(), batch_size=8, epochs=2, verbose=0,
+               shuffle=False, resume=ck, callbacks=[again])
+    assert again.losses == []
+
+
+# ---------------------------------------------------------------------------
+# Engine.fit(resume=...)
+# ---------------------------------------------------------------------------
+
+class ToyRegress(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(1)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        self.y = rng.randn(n, 4).astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _make_engine():
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.process_mesh import ProcessMesh
+
+    with unique_name.guard():
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    loss = lambda out, y: ((out - y) ** 2).mean()  # noqa: E731
+    eng = Engine(model=net, loss=loss, optimizer=opt,
+                 process_mesh=ProcessMesh(np.array([0]), dim_names=["dp"]))
+    return eng, net
+
+
+def _param(net, name="weight"):
+    v = net.state_dict()[name]
+    return np.asarray(v._value if hasattr(v, "_value") else v)
+
+
+def test_engine_kill_and_resume_params_bitwise(tmp_path, _no_persistent_compile_cache):
+    np.random.seed(7)
+    eng, net_a = _make_engine()
+    eng.fit(ToyRegress(), batch_size=8, epochs=2, prefetch=2, log_freq=1)
+    ref = _param(net_a)
+
+    ck = str(tmp_path / "eng_ck")
+    np.random.seed(7)
+    eng, _ = _make_engine()
+    inject.arm("sigterm", "train.step", at=5)
+    with pytest.raises(TrainingPreempted):
+        eng.fit(ToyRegress(), batch_size=8, epochs=2, prefetch=2,
+                log_freq=1, resume=ck)
+    inject.disarm_all()
+    eng, net_b = _make_engine()
+    eng.fit(ToyRegress(), batch_size=8, epochs=2, prefetch=2, log_freq=1,
+            resume=ck)
+    assert np.array_equal(ref, _param(net_b))
+
+
+# ---------------------------------------------------------------------------
+# ModelCheckpoint: final aliasing + keep_last_n
+# ---------------------------------------------------------------------------
+
+def _fit_with_ckpt(tmp_path, epochs, save_freq, keep_last_n=None):
+    model = _make_model(lambda net: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=net.parameters()))
+    d = str(tmp_path / "mc")
+    mc = ModelCheckpoint(save_freq, d, keep_last_n=keep_last_n)
+    model.fit(ToyClassify(32), batch_size=16, epochs=epochs, verbose=0,
+              callbacks=[mc])
+    return d
+
+
+def test_model_checkpoint_final_aliases_last_saved_epoch(tmp_path):
+    d = _fit_with_ckpt(tmp_path, epochs=2, save_freq=1)
+    final = os.path.join(d, "final.pdparams")
+    assert os.path.exists(final)
+    # the last epoch WAS saved by save_freq: final must alias it, not be a
+    # second serialization of the same state
+    assert os.path.samefile(final, os.path.join(d, "1.pdparams"))
+
+
+def test_model_checkpoint_final_written_when_not_covered(tmp_path):
+    d = _fit_with_ckpt(tmp_path, epochs=2, save_freq=2)  # saves epoch 0 only
+    final = os.path.join(d, "final.pdparams")
+    assert os.path.exists(final)
+    assert not os.path.samefile(final, os.path.join(d, "0.pdparams"))
+
+
+def test_model_checkpoint_keep_last_n_prunes(tmp_path):
+    d = _fit_with_ckpt(tmp_path, epochs=4, save_freq=1, keep_last_n=2)
+    present = sorted(f for f in os.listdir(d) if f.endswith(".pdparams"))
+    assert present == ["2.pdparams", "3.pdparams", "final.pdparams"]
+
+
+# ---------------------------------------------------------------------------
+# incubate auto_checkpoint: marker lands last, resume works
+# ---------------------------------------------------------------------------
+
+def test_auto_checkpoint_marker_names_existing_state(tmp_path):
+    import json
+
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+
+    acp.reset()
+    with unique_name.guard():
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    acp.register(model=net, optimizer=opt)
+    d = str(tmp_path / "acp")
+    ran = [e for e in acp.train_epoch_range(3, d)]
+    assert ran == [0, 1, 2]
+    with open(os.path.join(d, "acp_meta.json")) as f:
+        marker = json.load(f)
+    assert marker["epoch"] == 2
+    for fname in marker["state_files"]:
+        assert os.path.exists(os.path.join(d, fname)), fname
+    # a rerun resumes past the completed range
+    assert [e for e in acp.train_epoch_range(3, d)] == []
+    acp.reset()

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Checkpoint doctor: verify / inspect / prune ``fault.CheckpointManager``
+checkpoint directories from the shell.
+
+Usage::
+
+    python tools/ckpt_doctor.py verify  <ckpt_dir> [--step N]
+    python tools/ckpt_doctor.py inspect <ckpt_dir> [--step N]
+    python tools/ckpt_doctor.py prune   <ckpt_dir> --keep N [--dry-run]
+
+``verify`` re-checks every payload against the manifest CRC32s (exit 1 on
+any corruption — CI-friendly); ``inspect`` adds per-payload tensor
+shapes/dtypes; ``prune`` deletes the oldest step dirs beyond ``--keep``.
+
+``verify`` and ``prune`` are stdlib-only (json + zlib over the manifest
+layout) so they work on machines without the framework installed;
+``inspect`` unpickles payloads and needs numpy.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import zlib
+
+STEP_PREFIX = "step_"
+MANIFEST = "manifest.json"
+LATEST = "latest"
+
+
+def _steps(root):
+    out = []
+    for name in os.listdir(root):
+        if name.startswith(STEP_PREFIX):
+            try:
+                out.append(int(name[len(STEP_PREFIX):]))
+            except ValueError:
+                pass
+    return sorted(out)
+
+
+def _latest(root):
+    try:
+        with open(os.path.join(root, LATEST)) as f:
+            name = f.read().strip()
+        return int(name[len(STEP_PREFIX):])
+    except (OSError, ValueError):
+        return None
+
+
+def _crc32_file(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            crc = zlib.crc32(b, crc)
+    return crc & 0xFFFFFFFF
+
+
+def _verify_step(root, step):
+    """Returns (manifest | None, [problem strings])."""
+    d = os.path.join(root, f"{STEP_PREFIX}{step:08d}")
+    mpath = os.path.join(d, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, [f"manifest unreadable: {e}"]
+    problems = []
+    for name, ent in manifest.get("payloads", {}).items():
+        fpath = os.path.join(d, ent["file"])
+        if not os.path.exists(fpath):
+            problems.append(f"{name}: missing {ent['file']}")
+            continue
+        size = os.path.getsize(fpath)
+        if size != ent["size"]:
+            problems.append(f"{name}: size {size} != manifest {ent['size']}")
+        elif _crc32_file(fpath) != ent["crc32"]:
+            problems.append(f"{name}: crc32 mismatch")
+    return manifest, problems
+
+
+def cmd_verify(args):
+    steps = [args.step] if args.step is not None else _steps(args.ckpt_dir)
+    if not steps:
+        print(f"no {STEP_PREFIX}* checkpoints under {args.ckpt_dir}")
+        return 1
+    latest = _latest(args.ckpt_dir)
+    bad = 0
+    for s in steps:
+        manifest, problems = _verify_step(args.ckpt_dir, s)
+        mark = " <- latest" if s == latest else ""
+        if problems:
+            bad += 1
+            print(f"step {s:>10}  CORRUPT{mark}")
+            for p in problems:
+                print(f"    {p}")
+        else:
+            n = len(manifest.get("payloads", {}))
+            print(f"step {s:>10}  ok ({n} payloads){mark}")
+    if latest is not None and latest not in steps and args.step is None:
+        bad += 1
+        print(f"latest pointer names missing step {latest}")
+    return 1 if bad else 0
+
+
+def _describe(obj, prefix="", out=None, limit=200):
+    out = out if out is not None else []
+    if len(out) >= limit:
+        return out
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        out.append(f"    {prefix}: {obj.dtype} {tuple(obj.shape)}")
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _describe(v, f"{prefix}.{k}" if prefix else str(k), out, limit)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            _describe(v, f"{prefix}[{i}]", out, limit)
+    else:
+        out.append(f"    {prefix}: {type(obj).__name__} = {obj!r:.60}")
+    return out
+
+
+def cmd_inspect(args):
+    steps = _steps(args.ckpt_dir)
+    if not steps:
+        print(f"no {STEP_PREFIX}* checkpoints under {args.ckpt_dir}")
+        return 1
+    step = args.step if args.step is not None else (_latest(args.ckpt_dir)
+                                                    or steps[-1])
+    manifest, problems = _verify_step(args.ckpt_dir, step)
+    print(f"checkpoint {args.ckpt_dir} step {step} "
+          f"({'CORRUPT: ' + '; '.join(problems) if problems else 'verified'})")
+    if manifest is None:
+        return 1
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from paddle_tpu.framework.io import load as pload
+
+    d = os.path.join(args.ckpt_dir, f"{STEP_PREFIX}{step:08d}")
+    for name, ent in manifest.get("payloads", {}).items():
+        print(f"  {name} ({ent['file']}, {ent['size']} bytes)")
+        try:
+            payload = pload(os.path.join(d, ent["file"]), return_numpy=True)
+        except Exception as e:
+            print(f"    <unreadable: {e}>")
+            continue
+        for line in _describe(payload):
+            print(line)
+    return 1 if problems else 0
+
+
+def cmd_prune(args):
+    steps = _steps(args.ckpt_dir)
+    latest = _latest(args.ckpt_dir)
+    victims = [s for s in steps[:-args.keep]] if args.keep else []
+    victims = [s for s in victims if s != latest]
+    for s in victims:
+        d = os.path.join(args.ckpt_dir, f"{STEP_PREFIX}{s:08d}")
+        if args.dry_run:
+            print(f"would prune {d}")
+        else:
+            shutil.rmtree(d, ignore_errors=True)
+            print(f"pruned {d}")
+    kept = [s for s in steps if s not in victims]
+    print(f"kept {len(kept)}/{len(steps)} checkpoints: {kept}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("verify", cmd_verify), ("inspect", cmd_inspect),
+                     ("prune", cmd_prune)):
+        p = sub.add_parser(name)
+        p.add_argument("ckpt_dir")
+        p.set_defaults(fn=fn)
+        if name in ("verify", "inspect"):
+            p.add_argument("--step", type=int, default=None)
+        if name == "prune":
+            p.add_argument("--keep", type=int, required=True)
+            p.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

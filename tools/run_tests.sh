@@ -89,6 +89,30 @@ PYEOF
   # double-trace) fails the runner via its exit status
   JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert \
     --jsonl "$SMOKE_DIR/graph_lint.jsonl"
+  # checkpoint-doctor smoke: write two CheckpointManager steps (one torn
+  # via fault injection), then exercise the verify/inspect/prune CLI —
+  # verify MUST flag the torn step (exit 1) and pass the intact one
+  JAX_PLATFORMS=cpu python - "$SMOKE_DIR/ckpt" <<'PYEOF'
+import sys
+import numpy as np
+from paddle_tpu.fault import CheckpointManager, inject
+
+m = CheckpointManager(sys.argv[1])
+m.save(1, {"model": {"w": np.arange(8, dtype=np.float32)},
+           "cursor": {"epoch": 0, "step": 1}})
+inject.arm("torn", "ckpt.write", at=1)
+m.save(2, {"model": {"w": np.ones(8, np.float32)},
+           "cursor": {"epoch": 0, "step": 2}})
+inject.disarm_all()
+assert m.verify(2), "torn injection failed to corrupt step 2"
+assert m.load()[0] == 1, "fallback to verified step 1 failed"
+PYEOF
+  if python tools/ckpt_doctor.py verify "$SMOKE_DIR/ckpt"; then
+    echo "ckpt_doctor verify missed the torn checkpoint" >&2; exit 1
+  fi
+  python tools/ckpt_doctor.py verify "$SMOKE_DIR/ckpt" --step 1
+  python tools/ckpt_doctor.py inspect "$SMOKE_DIR/ckpt" --step 1
+  python tools/ckpt_doctor.py prune "$SMOKE_DIR/ckpt" --keep 1 --dry-run
   rm -rf "$SMOKE_DIR"
 fi
 
