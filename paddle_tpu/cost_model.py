@@ -29,15 +29,17 @@ class CostModel:
     def static_cost_data(self, fn=None, args=()):
         """Analytical (compile-time) cost: flops, bytes accessed, and the
         compiler's time estimate for the whole program."""
+        from .profiler.devprof import normalize_cost_analysis
+
         lowered, _ = self._lowered(fn, args)
         compiled = lowered.compile()
-        ca = compiled.cost_analysis() or {}
-        if isinstance(ca, (list, tuple)):  # older jax returns [dict]
-            ca = ca[0] if ca else {}
+        # one shared shim over jax's unstable return shape (list of
+        # per-computation dicts / dict / None) — see profiler.devprof
+        ca = normalize_cost_analysis(compiled.cost_analysis())
         return {
-            "flops": float(ca.get("flops", 0.0)),
-            "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
-            "optimal_seconds": float(ca.get("optimal_seconds", 0.0)),
+            "flops": ca.get("flops", 0.0),
+            "bytes_accessed": ca.get("bytes accessed", 0.0),
+            "optimal_seconds": ca.get("optimal_seconds", 0.0),
             "raw": dict(ca),
         }
 

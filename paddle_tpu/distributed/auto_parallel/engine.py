@@ -449,6 +449,19 @@ class Engine:
         model.train()
         return outs
 
+    def device_report(self):
+        """The harvested :class:`~paddle_tpu.profiler.devprof.
+        DeviceCostReport` of the compiled SPMD train step (auto-harvested
+        on its first compile while telemetry is enabled), else None. The
+        collective section attributes bytes per mesh axis — dp gradient
+        all-reduce, TP activation psum, MoE all_to_all — from the compiled
+        HLO."""
+        from ...profiler import devprof
+
+        if self._train_step is not None:
+            return devprof.get_report(self._train_step.name)
+        return None
+
     def save(self, path, training=True):
         from ...framework.io import save
 

@@ -82,15 +82,30 @@ class ElasticManager:
     def register(self):
         self.heartbeat()
 
-    def heartbeat(self, status="running"):
+    def heartbeat(self, status="running", step_time_s=None):
         """One keepalive write. Transient registry errors (flaky NFS, a
         rebinding store) retry with jittered exponential backoff instead of
         killing the agent's watch loop — losing the heartbeat thread makes
         every peer see THIS rank as stale and forces a cluster-wide
-        restart, the exact failure the heartbeat exists to prevent."""
+        restart, the exact failure the heartbeat exists to prevent.
+
+        ``step_time_s`` rides along for straggler detection; when omitted
+        it is pulled from the telemetry ``step.time_s`` gauge (the wall
+        time of the rank's last closed step record) if telemetry is on."""
         from ..fault.retry import retry
 
+        if step_time_s is None:
+            try:
+                from ..profiler import telemetry
+
+                if telemetry.enabled():
+                    step_time_s = telemetry.get_telemetry().gauges().get(
+                        "step.time_s")
+            except Exception:
+                step_time_s = None
         payload = {"rank": self.rank, "ts": time.time(), "status": status}
+        if step_time_s is not None:
+            payload["step_time_s"] = float(step_time_s)
         if self._store is not None:
             from ..core.tcp_store import TCPStoreError
 
@@ -140,6 +155,29 @@ class ElasticManager:
 
     def world(self):
         return sorted(self._peers())
+
+    def step_times(self):
+        """Per-rank step wall times from the latest heartbeats:
+        ``{rank: step_time_s}`` (ranks that never reported one are
+        absent)."""
+        return {r: float(p["step_time_s"]) for r, p in self._peers().items()
+                if isinstance(p.get("step_time_s"), (int, float))}
+
+    def stragglers(self, ratio=1.5):
+        """Ranks whose reported step time exceeds ``ratio`` × the median
+        of all reporting peers — in an SPMD job every rank runs the same
+        program, so a persistent outlier means a sick host/link, and the
+        whole slice runs at its pace. Needs >= 2 reporting ranks."""
+        times = self.step_times()
+        if len(times) < 2:
+            return []
+        xs = sorted(times.values())
+        mid = len(xs) // 2
+        median = (xs[mid] if len(xs) % 2
+                  else 0.5 * (xs[mid - 1] + xs[mid]))
+        if median <= 0:
+            return []
+        return sorted(r for r, t in times.items() if t > ratio * median)
 
     def watch(self):
         """One poll of the membership (reference's watch loop body):

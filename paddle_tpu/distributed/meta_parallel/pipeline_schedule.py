@@ -164,9 +164,38 @@ class PipelinedModel(Layer):
         self._template.eval()
         return super().eval()
 
+    # -- schedule observability ----------------------------------------------
+    def pipeline_stats(self):
+        """Static schedule metrics: the scan runs ``T = M + pp − 1`` ticks
+        of which ``pp − 1`` are ramp-up/drain bubbles on every stage —
+        the 1F1B bubble cost this schedule pays (see
+        ``devprof.pipeline_bubble_fraction``)."""
+        from ...profiler.devprof import pipeline_bubble_fraction
+
+        return {
+            "pp_degree": self._pp,
+            "num_microbatches": self._m,
+            "ticks": self._m + self._pp - 1,
+            "bubble_fraction": pipeline_bubble_fraction(self._m, self._pp),
+        }
+
+    def _publish_stats(self):
+        """Register the schedule metrics as ``pipeline.*`` telemetry
+        gauges (no-op while telemetry is disabled)."""
+        from ...profiler import telemetry as _tm
+
+        if not _tm.enabled():
+            return
+        st = self.pipeline_stats()
+        t = _tm.get_telemetry()
+        t.set_gauge("pipeline.bubble_fraction", st["bubble_fraction"])
+        t.set_gauge("pipeline.pp_degree", st["pp_degree"])
+        t.set_gauge("pipeline.num_microbatches", st["num_microbatches"])
+
     # -- the pipelined forward+loss as one autograd op -----------------------
     def forward(self, input_ids, labels=None):
         """Returns the scalar loss (labels required) or last-stage outputs."""
+        self._publish_stats()  # host-side; runs once per trace under jit
         pre_params = list(self.pre.parameters())
         post_params = list(self.post.parameters())
         n_pre, n_post, n_stack = len(pre_params), len(post_params), len(self._stacked)

@@ -20,6 +20,9 @@ point                   instrumented site
                         raises :class:`~paddle_tpu.fault.retry.TransientError`
 ``worker.fetch``        ``io.worker`` process-pool sample fetch — ``kill``
                         SIGKILLs the worker process
+``dispatch``            ``jit.CompiledStep`` device dispatch — ``oom``
+                        raises a ``RESOURCE_EXHAUSTED`` stand-in that
+                        exercises the devprof OOM-forensics path
 ======================  ======================================================
 
 Arming: programmatic ``arm(kind, point, at=N, once_file=...)`` or the
@@ -29,8 +32,8 @@ worker processes. ``once_file`` gives cross-process once-only semantics: the
 first process to claim the file (O_EXCL create) fires; respawned workers
 re-hitting the same sample index do not die again.
 
-Kinds: ``sigterm`` | ``kill`` | ``error`` (raised from ``check``) and
-``torn`` (returned from ``check`` for the writer to act on).
+Kinds: ``sigterm`` | ``kill`` | ``error`` | ``oom`` (raised from ``check``)
+and ``torn`` (returned from ``check`` for the writer to act on).
 """
 from __future__ import annotations
 
@@ -41,10 +44,15 @@ import threading
 from .retry import TransientError
 
 __all__ = ["arm", "disarm_all", "check", "armed", "TransientError",
-           "KINDS", "ENV_VAR"]
+           "InjectedResourceExhausted", "KINDS", "ENV_VAR"]
 
 ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
-KINDS = ("sigterm", "kill", "error", "torn")
+KINDS = ("sigterm", "kill", "error", "torn", "oom")
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Stand-in for XLA's ``XlaRuntimeError: RESOURCE_EXHAUSTED`` — the
+    message carries the same marker devprof's OOM detection keys on."""
 
 _lock = threading.Lock()
 _armed: list[dict] = []
@@ -154,4 +162,8 @@ def check(point):
         os.kill(os.getpid(), signal.SIGKILL)
     if kind == "error":
         raise TransientError(f"injected transient error at {point!r}")
+    if kind == "oom":
+        raise InjectedResourceExhausted(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at {point!r} "
+            f"(fault injection)")
     return "torn"

@@ -18,6 +18,7 @@ __all__ = [
     "EarlyStopping",
     "VisualDL",
     "TelemetryLogger",
+    "DeviceStatsLogger",
 ]
 
 
@@ -372,6 +373,72 @@ class TelemetryLogger(Callback):
             self._writer = None
         if self.print_report:
             telemetry.report()
+        if self._enabled_here:
+            telemetry.disable()
+            self._enabled_here = False
+
+
+class DeviceStatsLogger(Callback):
+    """Surface the compile-time device ground truth for the run's train
+    step: with telemetry enabled (this callback enables it), the step
+    auto-harvests a ``profiler.devprof.DeviceCostReport`` on its first
+    compile — FLOPs, bytes accessed, the HBM peak broken into
+    argument/output/temp/generated-code, and per-mesh-axis collective
+    bytes. The report prints at train end, is kept on ``self.report``, and
+    its ``hbm.*``/``comm.*``/``cost.*`` scalars export to a LogWriter
+    JSONL (render with ``tools/mem_report.py``).
+
+    Args:
+        log_dir: JSONL output directory; ``None`` keeps it in-memory.
+        print_report: print ``report.table()`` at train end.
+    """
+
+    def __init__(self, log_dir=None, print_report=True):
+        super().__init__()
+        self.log_dir = log_dir
+        self.print_report = print_report
+        self.report = None
+        self._enabled_here = False
+
+    def _tm(self):
+        from ..profiler import telemetry
+
+        return telemetry
+
+    def on_train_begin(self, logs=None):
+        telemetry = self._tm()
+        self.report = None
+        if not telemetry.enabled():
+            telemetry.enable()
+            self._enabled_here = True
+
+    def _fetch(self):
+        if self.report is not None:
+            return self.report
+        from ..profiler import devprof
+
+        step = getattr(self.model, "_train_step", None)
+        if step is not None:
+            self.report = devprof.get_report(getattr(step, "name", ""))
+        if self.report is None:
+            self.report = devprof.last_report()
+        return self.report
+
+    def on_train_batch_end(self, step, logs=None):
+        # the compiled step exists after the first batch; grab the harvest
+        # early so it survives a telemetry reset by other callbacks
+        self._fetch()
+
+    def on_train_end(self, logs=None):
+        rep = self._fetch()
+        telemetry = self._tm()
+        if self.log_dir:
+            from ..utils.log_writer import LogWriter
+
+            with LogWriter(self.log_dir) as w:
+                telemetry.get_telemetry().export_scalars(w)
+        if self.print_report and rep is not None:
+            print(rep.table())
         if self._enabled_here:
             telemetry.disable()
             self._enabled_here = False

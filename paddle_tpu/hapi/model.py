@@ -460,6 +460,19 @@ class Model:
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)
 
+    def device_report(self):
+        """The harvested :class:`~paddle_tpu.profiler.devprof.
+        DeviceCostReport` of the compiled train step (auto-harvested on
+        first compile while telemetry is enabled — e.g. under the
+        ``DeviceStatsLogger``/``TelemetryLogger`` callbacks), else None."""
+        from ..profiler import devprof
+
+        if self._train_step is not None:
+            rep = devprof.get_report(self._train_step.name)
+            if rep is not None:
+                return rep
+        return None
+
     def summary(self, input_size=None, dtype=None):
         from .model_summary import summary
 

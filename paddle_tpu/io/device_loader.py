@@ -195,6 +195,16 @@ class DeviceLoader:
             with self._lock:
                 if entry in self._active:
                     self._active.remove(entry)
+            self._clear_gauges()
+
+    def _clear_gauges(self):
+        """Retire this loader's point-in-time gauges (queue depth etc.) so
+        a finished epoch doesn't leave stale device stats in the next
+        ``telemetry.report()``; cumulative counters (prefetch hits/misses,
+        bytes staged) stay. Unconditional on the enabled flag — collected
+        data stays readable after ``disable()``, so stale gauges would
+        too."""
+        _telemetry.get_telemetry().clear_gauges("device_loader.")
 
     def shutdown(self):
         """Stop all live stager threads (abandoned epoch iterators)."""
@@ -203,6 +213,7 @@ class DeviceLoader:
         for t, done in active:
             done.set()
             t.join(timeout=5.0)
+        self._clear_gauges()
 
     @property
     def _live_threads(self):

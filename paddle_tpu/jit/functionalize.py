@@ -32,6 +32,7 @@ from ..optimizer.optimizer import Optimizer
 __all__ = ["functionalize", "CompiledStep", "to_static", "not_to_static"]
 
 _analysis_mod = None
+_devprof_mod = None
 
 
 def _analysis():
@@ -44,6 +45,17 @@ def _analysis():
 
         _analysis_mod = _a
     return _analysis_mod
+
+
+def _devprof():
+    """Cached handle to paddle_tpu.profiler.devprof (lazy, same rationale
+    as :func:`_analysis`)."""
+    global _devprof_mod
+    if _devprof_mod is None:
+        from ..profiler import devprof as _d
+
+        _devprof_mod = _d
+    return _devprof_mod
 
 
 def _layer_refs(layer: Layer):
@@ -144,10 +156,14 @@ def _is_dynamic_leaf(leaf):
     """Traced-array leaf vs static python attribute. Python scalars/strings
     are STATIC — they are op attributes in the reference's ProgramDesc, not
     tensors — so a new value recompiles rather than becoming a tracer (this
-    is what lets python control flow on them unroll at trace time)."""
+    is what lets python control flow on them unroll at trace time).
+    ``ShapeDtypeStruct`` counts as dynamic so ``lower``/``analyze``/devprof
+    harvesting can run from shapes alone, without live (possibly donated)
+    buffers."""
     import numpy as np
 
-    return (isinstance(leaf, (jax.Array, np.ndarray, np.generic))
+    return (isinstance(leaf, (jax.Array, np.ndarray, np.generic,
+                              jax.ShapeDtypeStruct))
             or _is_tracer_val(leaf))
 
 
@@ -292,10 +308,24 @@ class CompiledStep:
         return dyn_donated, dyn_kept, (treedef, spec_t, mask)
 
     def _invoke(self, args, kwargs):
+        from ..fault import inject
+
         state = self.spec.snapshot()
         dyn_donated, dyn_kept, static = self._prepare(args, kwargs)
-        out_arrays, new_state = self._jitted(state, dyn_donated, dyn_kept,
-                                             static)
+        try:
+            inject.check("dispatch")  # oom/error injection (devprof tests)
+            out_arrays, new_state = self._jitted(state, dyn_donated, dyn_kept,
+                                                 static)
+        except Exception as e:
+            if _devprof().is_oom_error(e):
+                # device OOM at dispatch: dump the ranked forensics
+                # (memory breakdown, donation status, batch/state shapes)
+                # before re-raising the original XLA error
+                try:
+                    _devprof().dump_oom_forensics(self, e, args, kwargs)
+                except Exception:  # noqa: BLE001 - never mask the OOM
+                    pass
+            raise
         self.spec.install(new_state)
         self.spec.clear_grads()
         return jax.tree_util.tree_map(lambda a: _wrap(a), out_arrays)
@@ -312,6 +342,16 @@ class CompiledStep:
             return self._invoke(args, kwargs)
         marker = self._trace_marker
         marker["traced"] = False
+        # capture the batch signature (shapes only) BEFORE the call: if it
+        # traces, devprof harvests against it — the real buffers may be
+        # donated/consumed by then. Skipped once the harvest has run.
+        sig = None
+        if not getattr(self, "_devprof_done", False) \
+                and _devprof().auto_harvest_enabled():
+            try:
+                sig = _devprof()._shape_only((args, kwargs))
+            except Exception:
+                sig = None
         t0 = time.perf_counter_ns()
         out = self._invoke(args, kwargs)
         t1 = time.perf_counter_ns()
@@ -320,6 +360,10 @@ class CompiledStep:
             # traced this call: wall time is dominated by trace+XLA compile;
             # repeated hits here for one step name = shape/dtype churn
             tm.note_compile(self.name, t0, t1)
+            if sig is not None:
+                # first compile: harvest the DeviceCostReport (memory/cost/
+                # comm ground truth) into the telemetry registry
+                _devprof().maybe_harvest_on_compile(self, sig[0], sig[1])
         else:
             # cache hit: host-side enqueue of the async device execution
             tm.add_phase("dispatch", t0, t1)
@@ -330,6 +374,14 @@ class CompiledStep:
         trace only, nothing runs on device. Returns a
         :class:`paddle_tpu.analysis.LintReport`."""
         return _analysis().lint_step(self, *args, **kwargs)
+
+    def device_report(self, *args, **kwargs):
+        """Harvest the compile-time :class:`~paddle_tpu.profiler.devprof.
+        DeviceCostReport` for this step against the example batch: FLOPs,
+        bytes accessed, the HBM peak breakdown, and per-mesh-axis
+        collective bytes. Arguments are reduced to shapes before lowering,
+        so donated/consumed batches are safe to pass."""
+        return _devprof().device_report(self, *args, **kwargs)
 
     def lower(self, *args, **kwargs):
         state = self.spec.snapshot()

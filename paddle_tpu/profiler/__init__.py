@@ -1,4 +1,5 @@
 """paddle.profiler (reference ``python/paddle/profiler/__init__.py``)."""
+from . import devprof  # noqa: F401
 from . import telemetry  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler,
@@ -18,5 +19,5 @@ from .profiler import (  # noqa: F401
 __all__ = [
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
-    "load_profiler_result", "SortedKeys", "telemetry",
+    "load_profiler_result", "SortedKeys", "telemetry", "devprof",
 ]

@@ -142,6 +142,9 @@ class Telemetry(Monitor):
         self._gauges = {}
         self._ring = collections.deque(maxlen=self.ring_size)
         self._spans = collections.deque(maxlen=self.ring_size * 8)
+        # bounded per-phase sample reservoirs for the p50/p95 columns
+        # (Monitor.add only keeps count/sum/min/max)
+        self._phase_samples = {}
         self._current = None
         self._next_step = 0
         self._compiles = {}
@@ -155,6 +158,19 @@ class Telemetry(Monitor):
     def set_gauge(self, name, value):
         with self._lock:
             self._gauges[name] = float(value)
+
+    def clear_gauge(self, name):
+        """Drop one gauge (a finished producer retiring its stat)."""
+        with self._lock:
+            self._gauges.pop(name, None)
+
+    def clear_gauges(self, prefix):
+        """Drop every gauge under ``prefix`` — e.g. a shut-down
+        ``DeviceLoader`` clearing its ``device_loader.*`` stats so the next
+        ``report()`` doesn't show a stale queue depth."""
+        with self._lock:
+            for k in [k for k in self._gauges if k.startswith(prefix)]:
+                del self._gauges[k]
 
     def observe(self, name, seconds):
         """Time-histogram sample (Monitor count/sum/min/max under `name`)."""
@@ -170,6 +186,14 @@ class Telemetry(Monitor):
             return dict(self._gauges)
 
     # -- step timeline ------------------------------------------------------
+    def _close_record(self, cur):
+        """Append a phase-bearing record to the ring and publish its wall
+        time as the ``step.time_s`` gauge (the per-rank step-time signal
+        the elastic heartbeat forwards for straggler detection). Caller
+        holds the lock."""
+        self._ring.append(cur)
+        self._gauges["step.time_s"] = cur.wall_s
+
     def step_begin(self):
         """Open a step record, closing (and keeping) any open one that saw
         phases. Loops call this before the iteration *and* at the end of
@@ -177,7 +201,7 @@ class Telemetry(Monitor):
         with self._lock:
             cur = self._current
             if cur is not None and cur.phases:
-                self._ring.append(cur)
+                self._close_record(cur)
             self._current = _StepRecord(self._next_step,
                                         time.perf_counter_ns())
             self._next_step += 1
@@ -188,7 +212,7 @@ class Telemetry(Monitor):
             cur = self._current
             self._current = None
             if cur is not None and cur.phases:
-                self._ring.append(cur)
+                self._close_record(cur)
 
     def add_phase(self, name, start_ns, end_ns):
         """Record one phase span: histogram + chrome span + the open step."""
@@ -196,6 +220,8 @@ class Telemetry(Monitor):
         tid = threading.get_ident()
         with self._lock:
             self.add(f"phase.{name}", secs)
+            self._phase_samples.setdefault(
+                name, collections.deque(maxlen=2048)).append(secs)
             self._spans.append((name, start_ns, end_ns, tid))
             cur = self._current
             if cur is not None:
@@ -240,8 +266,18 @@ class Telemetry(Monitor):
             return sum(n - 1 for n in self._compiles.values() if n > 1)
 
     # -- export -------------------------------------------------------------
+    @staticmethod
+    def _percentile(xs, q):
+        """Nearest-rank percentile over a sorted list."""
+        if not xs:
+            return 0.0
+        idx = min(int(round(q * (len(xs) - 1))), len(xs) - 1)
+        return xs[idx]
+
     def phase_stats(self):
-        """{phase: {count, sum, min, max, mean}} from the histograms."""
+        """{phase: {count, sum, min, max, mean, p50, p95}} from the
+        histograms; p50/p95 come from a bounded (last 2048 samples)
+        per-phase reservoir."""
         out = {}
         with self._lock:
             for key in self.names():
@@ -249,7 +285,11 @@ class Telemetry(Monitor):
                     continue
                 s = self.get(key)
                 s["mean"] = s["sum"] / s["count"] if s.get("count") else 0.0
-                out[key[len("phase."):]] = s
+                name = key[len("phase."):]
+                xs = sorted(self._phase_samples.get(name, ()))
+                s["p50"] = self._percentile(xs, 0.50)
+                s["p95"] = self._percentile(xs, 0.95)
+                out[name] = s
         return out
 
     def chrome_spans(self):
@@ -297,16 +337,23 @@ class Telemetry(Monitor):
             writer.add_scalar(f"telemetry/phase/{name}/total_s", s["sum"], step)
             writer.add_scalar(f"telemetry/phase/{name}/count", s["count"], step)
             writer.add_scalar(f"telemetry/phase/{name}/mean_s", s["mean"], step)
+            writer.add_scalar(f"telemetry/phase/{name}/p50_s", s["p50"], step)
+            writer.add_scalar(f"telemetry/phase/{name}/p95_s", s["p95"], step)
         for name, v in last_phases.items():
             writer.add_scalar(f"telemetry/step/{name}_s", v, step)
+
+    #: gauge/counter prefixes rendered in the device-stats section of
+    #: ``report()`` / ``tools/telemetry_report.py`` (devprof harvest)
+    DEVICE_PREFIXES = ("hbm.", "comm.", "cost.", "pipeline.", "oom.")
 
     def report(self, file=None):
         """Phase-breakdown + counter summary table (printed and returned,
         mirroring ``Profiler.summary``)."""
         s = self.summary()
         lines = [f"{'Phase':<12} {'Count':>8} {'Total(s)':>12} "
-                 f"{'Mean(ms)':>12} {'Frac(%)':>9}"]
-        lines.append("-" * 58)
+                 f"{'Mean(ms)':>12} {'P50(ms)':>10} {'P95(ms)':>10} "
+                 f"{'Frac(%)':>9}"]
+        lines.append("-" * 79)
         wall = s["step_wall_s"]
         denom = wall or sum(st["sum"] for st in s["phases"].values()) or 1.0
         order = [p for p in PHASES if p in s["phases"]]
@@ -315,20 +362,57 @@ class Telemetry(Monitor):
             st = s["phases"][name]
             lines.append(
                 f"{name:<12} {st['count']:>8} {st['sum']:>12.4f} "
-                f"{st['mean'] * 1e3:>12.3f} {100.0 * st['sum'] / denom:>9.2f}")
-        lines.append("-" * 58)
+                f"{st['mean'] * 1e3:>12.3f} {st.get('p50', 0) * 1e3:>10.3f} "
+                f"{st.get('p95', 0) * 1e3:>10.3f} "
+                f"{100.0 * st['sum'] / denom:>9.2f}")
+        lines.append("-" * 79)
         lines.append(f"steps recorded: {s['steps_recorded']}  "
                      f"(wall {wall:.4f} s over the ring window)")
-        if s["counters"]:
+        dev_prefixes = self.DEVICE_PREFIXES
+
+        def _is_dev(k):
+            return any(k.startswith(p) for p in dev_prefixes)
+
+        plain_counters = {k: v for k, v in s["counters"].items()
+                          if not _is_dev(k)}
+        dev_counters = {k: v for k, v in s["counters"].items() if _is_dev(k)}
+        plain_gauges = {k: v for k, v in s["gauges"].items()
+                        if not _is_dev(k)}
+        dev_gauges = {k: v for k, v in s["gauges"].items() if _is_dev(k)}
+        if plain_counters:
             lines.append("counters:")
-            for k in sorted(s["counters"]):
-                v = s["counters"][k]
+            for k in sorted(plain_counters):
+                v = plain_counters[k]
                 lines.append(f"  {k:<38} {v:g}" if isinstance(v, float)
                              else f"  {k:<38} {v}")
-        if s["gauges"]:
+        if plain_gauges:
             lines.append("gauges:")
-            for k in sorted(s["gauges"]):
-                lines.append(f"  {k:<38} {s['gauges'][k]:g}")
+            for k in sorted(plain_gauges):
+                lines.append(f"  {k:<38} {plain_gauges[k]:g}")
+        if dev_gauges or dev_counters:
+            # devprof harvest: HBM breakdown / collective bytes / pipeline
+            def _human(n):
+                n = float(n)
+                for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+                    if abs(n) < 1024.0 or unit == "TiB":
+                        return (f"{int(n)} B" if unit == "B"
+                                else f"{n:.1f} {unit}")
+                    n /= 1024.0
+
+            lines.append("device stats:")
+            for k in sorted(dev_gauges):
+                v = dev_gauges[k]
+                if k.endswith(("_bytes", ".bytes")):
+                    lines.append(f"  {k:<38} {_human(v)}")
+                else:
+                    lines.append(f"  {k:<38} {v:g}")
+            for k in sorted(dev_counters):
+                v = dev_counters[k]
+                if ".bytes." in k:
+                    lines.append(f"  {k:<38} {_human(v)}")
+                else:
+                    lines.append(f"  {k:<38} {v:g}" if isinstance(v, float)
+                                 else f"  {k:<38} {v}")
         if s["compiles"]:
             lines.append(f"recompiles beyond first: {s['recompile_count']}")
             for k in sorted(s["compiles"]):
@@ -349,6 +433,7 @@ class Telemetry(Monitor):
             self._gauges.clear()
             self._ring.clear()
             self._spans.clear()
+            self._phase_samples.clear()
             self._current = None
             self._next_step = 0
             self._compiles.clear()

@@ -117,8 +117,11 @@ def telemetry_block(total_seconds, steps):
     telemetry collected by ``measure_steps``: steps/s, mean data-wait
     fraction of the timed region, compile/recompile counts, per-phase
     seconds (measured steps only — warmup phases are outside the step
-    records), and DeviceLoader prefetch stats."""
-    from paddle_tpu.profiler import telemetry
+    records), DeviceLoader prefetch stats, and the devprof device ground
+    truth — ``hbm_peak_bytes`` (compiled HBM peak), ``comm_fraction``
+    (interconnect bytes / total memory traffic) and per-mesh-axis
+    collective byte counters — harvested at the step's first compile."""
+    from paddle_tpu.profiler import devprof, telemetry
 
     s = telemetry.summary()
     recs = telemetry.get_telemetry().steps()
@@ -127,6 +130,23 @@ def telemetry_block(total_seconds, steps):
         for k, v in r.phases.items():
             phase_s[k] = phase_s.get(k, 0.0) + v
     counters = s["counters"]
+    gauges = s["gauges"]
+    # device stats: prefer the live gauges; fall back to the harvest
+    # registry when another enable/reset cycle cleared them
+    hbm_peak = gauges.get("hbm.peak_bytes")
+    comm_fraction = gauges.get("comm.fraction")
+    comm_by_axis = {k[len("comm.bytes."):]: int(v)
+                    for k, v in counters.items()
+                    if k.startswith("comm.bytes.")}
+    rep = devprof.last_report()
+    if rep is not None:
+        if hbm_peak is None and rep.memory is not None:
+            hbm_peak = rep.memory.peak_bytes
+        if comm_fraction is None:
+            comm_fraction = rep.comm_fraction
+        if not comm_by_axis:
+            comm_by_axis = {a: int(st["bytes"])
+                            for a, st in rep.collectives.as_dict().items()}
     return {
         "steps_per_sec": round(steps / total_seconds, 3) if total_seconds
         else None,
@@ -143,19 +163,29 @@ def telemetry_block(total_seconds, steps):
             "bytes_staged": int(
                 counters.get("device_loader.bytes_staged", 0)),
         },
+        "hbm_peak_bytes": int(hbm_peak) if hbm_peak is not None else None,
+        "comm_fraction": (round(float(comm_fraction), 4)
+                          if comm_fraction is not None else None),
+        "comm_bytes_by_axis": comm_by_axis,
     }
 
 
 def compiled_flops(step, batches):
     """FLOPs of ONE compiled train step from XLA's own cost analysis
     (includes remat recompute — i.e. this yields hardware-FLOPs utilization,
-    the honest number for 'how busy is the MXU')."""
+    the honest number for 'how busy is the MXU'). Prefers the devprof
+    report harvested at the step's first compile (no second lowering);
+    falls back to lowering against the example batch."""
+    from paddle_tpu.profiler import devprof
+    from paddle_tpu.profiler.devprof import normalize_cost_analysis
+
+    rep = devprof.get_report(getattr(step, "name", ""))
+    if rep is not None and rep.flops:
+        return rep.flops
     try:
         lowered = step.lower(*batches[0])
-        cost = lowered.compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost.get("flops", 0.0)) or None
+        cost = normalize_cost_analysis(lowered.compile().cost_analysis())
+        return cost.get("flops", 0.0) or None
     except Exception as e:  # pragma: no cover - cost analysis is best-effort
         print(f"cost_analysis unavailable: {e!r}", file=sys.stderr)
         return None

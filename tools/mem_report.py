@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Ranked HBM/communication report from a telemetry JSONL.
+
+Reads the scalar stream written by ``profiler.telemetry.export_scalars``
+(via ``utils.log_writer.LogWriter`` — e.g. from the
+``hapi.callbacks.DeviceStatsLogger`` callback, or any run with telemetry on
+after ``profiler.devprof`` harvested a compiled step) and renders the
+device-side ground truth:
+
+* the HBM peak broken into argument/output/temp/generated-code segments,
+  ranked largest first with percent-of-peak;
+* per-mesh-axis collective traffic (``comm.bytes.<axis>`` /
+  ``comm.count.<axis>``), ranked by bytes, plus the comm-vs-compute
+  fraction;
+* compiled cost figures (FLOPs, bytes accessed) and pipeline-schedule
+  metrics when present.
+
+Usage::
+
+    python tools/mem_report.py <vdlrecords.jsonl | logdir>
+
+Stdlib-only on purpose: the CI smoke path (tools/run_tests.sh) runs it
+without importing jax (mirrors tools/telemetry_report.py / ckpt_doctor.py).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+HBM_ORDER = ("argument_bytes", "output_bytes", "temp_bytes",
+             "generated_code_bytes")
+
+
+def load_records(path):
+    """Parse one JSONL file (or the newest ``*.jsonl`` in a directory)."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl")),
+                       key=os.path.getmtime)
+        if not files:
+            raise FileNotFoundError(f"no *.jsonl files under {path}")
+        path = files[-1]
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # tolerate partial trailing writes
+    return path, records
+
+
+def collect(records):
+    """Fold the scalar stream to last-value per tag; split out the device
+    stats (telemetry/gauge/hbm.* etc. and telemetry/counter/comm.*)."""
+    last = {}
+    for r in records:
+        tag, value = r.get("tag"), r.get("value")
+        if isinstance(tag, str) and value is not None:
+            last[tag] = float(value)
+    hbm = {t[len("telemetry/gauge/hbm."):]: v for t, v in last.items()
+           if t.startswith("telemetry/gauge/hbm.")}
+    cost = {t[len("telemetry/gauge/cost."):]: v for t, v in last.items()
+            if t.startswith("telemetry/gauge/cost.")}
+    pipeline = {t[len("telemetry/gauge/pipeline."):]: v
+                for t, v in last.items()
+                if t.startswith("telemetry/gauge/pipeline.")}
+    comm_gauges = {t[len("telemetry/gauge/comm."):]: v
+                   for t, v in last.items()
+                   if t.startswith("telemetry/gauge/comm.")}
+    comm_bytes = {t[len("telemetry/counter/comm.bytes."):]: v
+                  for t, v in last.items()
+                  if t.startswith("telemetry/counter/comm.bytes.")}
+    comm_count = {t[len("telemetry/counter/comm.count."):]: v
+                  for t, v in last.items()
+                  if t.startswith("telemetry/counter/comm.count.")}
+    return hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count
+
+
+def human_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{int(n)} B" if unit == "B" else f"{n:.2f} {unit}"
+        n /= 1024.0
+
+
+def build_report(hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count):
+    lines = []
+    if hbm:
+        peak = hbm.get("peak_bytes") or 1.0
+        lines.append(f"HBM peak: {human_bytes(peak)}")
+        lines.append(f"  {'segment':<24} {'bytes':>14} {'% of peak':>10}")
+        lines.append("  " + "-" * 50)
+        segs = [(k, hbm.get(k, 0.0)) for k in HBM_ORDER]
+        for k, v in sorted(segs, key=lambda kv: -kv[1]):
+            if v:
+                lines.append(f"  {k:<24} {human_bytes(v):>14} "
+                             f"{100.0 * v / peak:>9.1f}%")
+        alias = hbm.get("alias_bytes", 0.0)
+        if alias:
+            lines.append(f"  {'alias (donated, reused)':<24} "
+                         f"{'-' + human_bytes(alias):>14}")
+    if cost:
+        lines.append("compiled cost:")
+        if cost.get("flops"):
+            lines.append(f"  {'flops':<24} {cost['flops']:>14,.0f}")
+        if cost.get("bytes_accessed"):
+            lines.append(f"  {'bytes accessed':<24} "
+                         f"{human_bytes(cost['bytes_accessed']):>14}")
+        if cost.get("optimal_seconds"):
+            lines.append(f"  {'optimal seconds':<24} "
+                         f"{cost['optimal_seconds']:>14.6f}")
+    if comm_bytes or comm_gauges:
+        frac = comm_gauges.get("fraction")
+        total = comm_gauges.get("bytes", sum(comm_bytes.values()))
+        lines.append(f"collective traffic: {human_bytes(total)} "
+                     f"moved/device"
+                     + (f", comm_fraction {frac:.4f}" if frac is not None
+                        else ""))
+        if comm_bytes:
+            lines.append(f"  {'mesh axis':<16} {'bytes':>14} {'ops':>6}")
+            lines.append("  " + "-" * 38)
+            for axis, v in sorted(comm_bytes.items(), key=lambda kv: -kv[1]):
+                n = int(comm_count.get(axis, 0))
+                lines.append(f"  {axis:<16} {human_bytes(v):>14} {n:>6}")
+    if pipeline:
+        lines.append("pipeline schedule:")
+        for k in sorted(pipeline):
+            lines.append(f"  {k:<24} {pipeline[k]:g}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv and argv[0] in ("-h", "--help") else 2
+    path, records = load_records(argv[0])
+    parts = collect(records)
+    if not any(parts):
+        print(f"{path}: no device stats (hbm.*/comm.*/cost.*) found — "
+              f"was the run harvested by profiler.devprof?",
+              file=sys.stderr)
+        return 1
+    print(f"device memory/comm report — {path}")
+    print(build_report(*parts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

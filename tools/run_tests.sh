@@ -83,6 +83,43 @@ with LogWriter(sys.argv[1], file_name="telemetry_smoke.jsonl") as w:
 telemetry.disable()
 PYEOF
   python tools/telemetry_report.py "$SMOKE_DIR/telemetry_smoke.jsonl"
+  # devprof smoke: compile a tiny train step with telemetry on (triggering
+  # the auto-harvest of memory/cost/comm ground truth), run it through the
+  # bench measurement path, assert the BENCH telemetry_block carries the
+  # new device keys, export the scalars, and render the ranked HBM/comm
+  # table with the stdlib-only tools/mem_report.py
+  JAX_PLATFORMS=cpu python - "$SMOKE_DIR" <<'PYEOF'
+import sys
+import numpy as np
+sys.path.insert(0, "tools")
+from bench_common import measure_steps, telemetry_block
+import paddle_tpu as paddle
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.profiler import devprof, telemetry
+from paddle_tpu.utils.log_writer import LogWriter
+
+paddle.seed(0)
+net = paddle.nn.Sequential(paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+                           paddle.nn.Linear(32, 16))
+opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+def train_step(x, y):
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+    return loss
+step = CompiledStep(train_step, stateful=[net, opt])
+rng = np.random.RandomState(0)
+batches = [(rng.rand(8, 16).astype("float32"),
+            rng.rand(8, 16).astype("float32")) for _ in range(8)]
+total, _ = measure_steps(step, batches, iters=4, warmup=2)
+blk = telemetry_block(total, 4)
+assert blk.get("hbm_peak_bytes"), f"missing hbm_peak_bytes: {blk}"
+assert blk.get("comm_fraction") is not None, f"missing comm_fraction: {blk}"
+rep = devprof.get_report("train_step")
+assert rep is not None and rep.memory.peak_bytes > 0
+with LogWriter(sys.argv[1], file_name="devprof_smoke.jsonl") as w:
+    telemetry.get_telemetry().export_scalars(w, step=4)
+PYEOF
+  python tools/mem_report.py "$SMOKE_DIR/devprof_smoke.jsonl"
   # graph-lint gate: statically lint the bench-zoo train steps (resnet +
   # bert, no device execution) — any error-severity finding (e.g. a
   # state-pytree retrace hazard like the Adam lazy-accumulator

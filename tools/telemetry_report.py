@@ -23,6 +23,22 @@ import sys
 
 PHASE_ORDER = ("data_wait", "h2d_copy", "compile", "dispatch", "readback")
 
+#: devprof harvest scalars rendered in their own section (matches
+#: Telemetry.DEVICE_PREFIXES)
+DEVICE_PREFIXES = ("hbm.", "comm.", "cost.", "pipeline.", "oom.")
+
+
+def _is_device_stat(name):
+    return any(name.startswith(p) for p in DEVICE_PREFIXES)
+
+
+def _human_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{int(n)} B" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+
 
 def load_records(path):
     """Parse one JSONL file (or the newest ``*.jsonl`` in a directory)."""
@@ -77,9 +93,14 @@ def collect(records):
 
 
 def build_table(phases, steps, counters, gauges):
-    lines = [f"{'Phase':<12} {'Count':>8} {'Total(s)':>12} {'Mean(ms)':>12} "
-             f"{'Frac(%)':>9}"]
-    lines.append("-" * 58)
+    has_pct = any("p50_s" in p or "p95_s" in p for p in phases.values())
+    head = f"{'Phase':<12} {'Count':>8} {'Total(s)':>12} {'Mean(ms)':>12} "
+    if has_pct:
+        head += f"{'P50(ms)':>10} {'P95(ms)':>10} "
+    head += f"{'Frac(%)':>9}"
+    lines = [head]
+    width = 79 if has_pct else 58
+    lines.append("-" * width)
     denom = sum(p.get("total_s", 0.0) for p in phases.values()) or 1.0
     order = [p for p in PHASE_ORDER if p in phases]
     order += [p for p in sorted(phases) if p not in PHASE_ORDER]
@@ -88,9 +109,13 @@ def build_table(phases, steps, counters, gauges):
         total = p.get("total_s", 0.0)
         count = int(p.get("count", 0))
         mean = p.get("mean_s", total / count if count else 0.0)
-        lines.append(f"{name:<12} {count:>8} {total:>12.4f} "
-                     f"{mean * 1e3:>12.3f} {100.0 * total / denom:>9.2f}")
-    lines.append("-" * 58)
+        row = f"{name:<12} {count:>8} {total:>12.4f} {mean * 1e3:>12.3f} "
+        if has_pct:
+            row += (f"{p.get('p50_s', 0.0) * 1e3:>10.3f} "
+                    f"{p.get('p95_s', 0.0) * 1e3:>10.3f} ")
+        row += f"{100.0 * total / denom:>9.2f}"
+        lines.append(row)
+    lines.append("-" * width)
     if steps:
         lines.append(f"{'per-step samples':<21} {'N':>6} {'Mean(ms)':>12} "
                      f"{'Max(ms)':>12}")
@@ -99,15 +124,37 @@ def build_table(phases, steps, counters, gauges):
             mean = s["sum"] / s["count"] if s["count"] else 0.0
             lines.append(f"  {name:<19} {s['count']:>6} {mean * 1e3:>12.3f} "
                          f"{s['max'] * 1e3:>12.3f}")
-    if counters:
+    plain_counters = {k: v for k, v in counters.items()
+                      if not _is_device_stat(k)}
+    dev_counters = {k: v for k, v in counters.items() if _is_device_stat(k)}
+    plain_gauges = {k: v for k, v in gauges.items() if not _is_device_stat(k)}
+    dev_gauges = {k: v for k, v in gauges.items() if _is_device_stat(k)}
+    if plain_counters:
         lines.append("counters:")
-        for k in sorted(counters):
-            v = counters[k]
+        for k in sorted(plain_counters):
+            v = plain_counters[k]
             lines.append(f"  {k:<38} {int(v) if v == int(v) else v}")
-    if gauges:
+    if plain_gauges:
         lines.append("gauges:")
-        for k in sorted(gauges):
-            lines.append(f"  {k:<38} {gauges[k]:g}")
+        for k in sorted(plain_gauges):
+            lines.append(f"  {k:<38} {plain_gauges[k]:g}")
+    if dev_gauges or dev_counters:
+        # devprof harvest: HBM breakdown, per-axis collective bytes,
+        # pipeline-schedule metrics (see tools/mem_report.py for the
+        # ranked standalone view)
+        lines.append("device stats:")
+        for k in sorted(dev_gauges):
+            v = dev_gauges[k]
+            if k.endswith(("_bytes", ".bytes")):
+                lines.append(f"  {k:<38} {_human_bytes(v)}")
+            else:
+                lines.append(f"  {k:<38} {v:g}")
+        for k in sorted(dev_counters):
+            v = dev_counters[k]
+            if ".bytes." in k:
+                lines.append(f"  {k:<38} {_human_bytes(v)}")
+            else:
+                lines.append(f"  {k:<38} {int(v) if v == int(v) else v}")
     return "\n".join(lines)
 
 
