@@ -117,6 +117,7 @@ from . import hub  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import dataset  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from . import tensor  # noqa: F401
 from .batch import batch  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -189,6 +189,64 @@ def _shape_churn(graph):
 
 
 @register_rule(
+    "kv-cache-concat", "error",
+    "a cache input grows along one axis step-to-step and is re-emitted "
+    "larger: grow-by-concat KV cache, one compile per position")
+def _kv_cache_concat(graph):
+    """The decode-loop killer: a cache operand whose shape differs between
+    two consecutive positions (example batches), growing along exactly one
+    axis, while the step also RETURNS a same-rank/same-dtype array that is
+    strictly larger on that axis — the signature of a KV cache grown with
+    ``concat`` and threaded back in. Every decode step then compiles a new
+    executable AND re-materializes the full cache in HBM (O(n) per step,
+    O(n²) per sequence). Distinct from generic ``retrace-shape-churn``:
+    the grown-output match is what identifies the operand as a cache
+    rather than an unpadded batch."""
+    base = {p: _shape_dtype(l) for p, l, _ in graph.dyn_args}
+    outs = [_shape_dtype(s) for _, s in graph.out_paths]
+    flagged = set()
+    for variant in graph.variants:
+        for path, shape, dtype in variant.get("dyn", ()):
+            if path in flagged:
+                continue
+            b = base.get(path)
+            if b is None or b[1] != str(dtype):
+                continue
+            bs, vs = b[0], tuple(int(s) for s in shape)
+            if len(bs) != len(vs) or bs == vs:
+                continue
+            diff = [i for i in range(len(bs)) if bs[i] != vs[i]]
+            if len(diff) != 1:
+                continue
+            ax = diff[0]
+            grown = any(
+                odt == b[1] and len(os) == len(bs) and os[ax] > bs[ax]
+                and all(os[i] == bs[i] for i in range(len(bs)) if i != ax)
+                for os, odt in outs)
+            if not grown:
+                continue
+            flagged.add(path)
+            yield Finding(
+                rule="kv-cache-concat",
+                severity="error",
+                message=f"cache input {path} grows {b[1]}{list(bs)} -> "
+                        f"{str(dtype)}{list(vs)} between consecutive "
+                        f"positions and the step emits it one step larger: "
+                        f"grow-by-concat decode compiles a new executable "
+                        f"and copies the full cache at EVERY position",
+                path=path,
+                hint="preallocate a static [batch, max_len, heads, "
+                     "head_dim] buffer and write each step in place at the "
+                     "position index (lax.dynamic_update_slice) — "
+                     "paddle_tpu.serving.KVCache / GenerationEngine "
+                     "compile prefill once per length bucket and decode "
+                     "exactly once",
+                data={"axis": ax, "base_shape": list(bs),
+                      "variant_shape": list(vs)},
+            )
+
+
+@register_rule(
     "retrace-weak-type", "info",
     "weakly-typed input leaf: strong/weak flips re-trace and promotions "
     "surprise")

@@ -93,7 +93,12 @@ class _Handle:
         self._arr = np.asarray(arr)
 
     def reshape(self, shape):
-        if self._arr is not None:
+        if self._arr is None:
+            # reference ZeroCopyTensor.Reshape preallocates the buffer so a
+            # later mutable_data/copy fills it; an unset handle silently
+            # no-opping here lost the declared shape entirely
+            self._arr = np.zeros(shape, dtype=np.float32)
+        else:
             self._arr = self._arr.reshape(shape)
 
     def copy_to_cpu(self):
@@ -142,7 +147,7 @@ class Predictor:
                 n_in = int(pickle.load(f).get("n_inputs", 1))
         self._in_names = [f"input_{i}" for i in range(n_in)]
         self._inputs = {n: _Handle() for n in self._in_names}
-        self._outputs = []
+        self._outputs = None  # populated by run(); None = never ran
 
     def get_input_names(self):
         return list(self._in_names)
@@ -170,11 +175,25 @@ class Predictor:
             self._outputs.append(h)
         return True
 
+    def _require_outputs(self):
+        if self._outputs is None:
+            raise RuntimeError(
+                "Predictor.run() has not been called: there are no outputs "
+                "yet — copy inputs via get_input_handle().copy_from_cpu() "
+                "and call run() first")
+        return self._outputs
+
     def get_output_names(self):
-        return [f"output_{i}" for i in range(len(self._outputs))]
+        return [f"output_{i}" for i in range(len(self._require_outputs()))]
 
     def get_output_handle(self, name):
-        return self._outputs[int(name.split("_")[-1])]
+        outputs = self._require_outputs()
+        idx = int(name.split("_")[-1])
+        if not 0 <= idx < len(outputs):
+            raise IndexError(
+                f"unknown output handle {name!r}: run() produced "
+                f"{len(outputs)} output(s) ({self.get_output_names()})")
+        return outputs[idx]
 
 
 def create_predictor(config: Config) -> Predictor:

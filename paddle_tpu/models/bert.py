@@ -178,3 +178,13 @@ class BertForSequenceClassification(Layer):
         _, pooled = self.bert(input_ids, token_type_ids,
                               attention_mask=attention_mask)
         return self.classifier(self.dropout(pooled))
+
+    def scorer(self, max_batch=8, seq_buckets=None, max_seq=None):
+        """Serving path: a bucketed compile-once-per-bucket batch scorer
+        (:class:`paddle_tpu.serving.EncoderScorer`) — requests are padded
+        to ``[max_batch, bucket]`` so one executable per sequence bucket
+        serves every request mix; padding rows are masked and dropped."""
+        from ..serving import EncoderScorer
+
+        return EncoderScorer(self, max_batch=max_batch,
+                             seq_buckets=seq_buckets, max_seq=max_seq)

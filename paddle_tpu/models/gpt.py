@@ -25,6 +25,7 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 from .. import ops
+from ..utils import warn_once
 from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..nn.layer.common import Dropout, Embedding, Linear
@@ -156,9 +157,28 @@ class GPTDecoderLayer(Layer):
         qkv = qkv.reshape([b, s, 3, nh_local, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cache is not None:
-            k = ops.concat([cache[0], k], axis=1)
-            v = ops.concat([cache[1], v], axis=1)
-            cache = (k.detach(), v.detach())
+            if isinstance(cache, (tuple, list)):
+                # DEPRECATED grow-by-concat path: every step changes the
+                # cache operand shape (one XLA executable per position — the
+                # analysis `kv-cache-concat` rule flags exactly this) and
+                # the concat re-materializes the full K/V in HBM per step.
+                # Kept as a shim for old callers; detach() here only drops
+                # autograd linkage — the arrays are shared, not copied.
+                warn_once(
+                    "gpt-kv-cache-concat",
+                    "tuple KV cache on GPTDecoderLayer is deprecated: it "
+                    "grows by concat and recompiles the decode step at "
+                    "every position. Use paddle_tpu.serving.KVCache / "
+                    "GenerationEngine for O(1) static-shape decode.")
+                k = ops.concat([cache[0], k], axis=1)
+                v = ops.concat([cache[1], v], axis=1)
+                cache = (k.detach(), v.detach())
+            else:
+                # serving.KVCache view (DecodeView/PrefillView): writes the
+                # new rows in place (dynamic_update_slice at a traced
+                # position index) and returns shape-stable K/V — the O(1)
+                # decode path; causality/validity live in attn_mask
+                k, v, cache = cache.update(k, v)
         if self._use_sep and cache is None and attn_mask is None:
             from ..distributed.meta_parallel import ring_attention
 
@@ -189,8 +209,17 @@ class GPTModel(Layer):
         self.layers = LayerList([GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                cache=None):
         h = self.embeddings(input_ids, position_ids)
+        if cache is not None:
+            # serving decode/prefill: one cache view per layer, collected
+            # back for the engine (single-chip path; sep/mp stay training)
+            new_cache = []
+            for layer, c in zip(self.layers, cache):
+                h, c = layer(h, attn_mask=attn_mask, cache=c)
+                new_cache.append(c)
+            return self.ln_f(h), new_cache
         # gate on the layers' frozen decision (made at construction against
         # the then-active hybrid mesh) so annotation and attention path agree
         if len(self.layers) and self.layers[0]._use_sep:
@@ -212,10 +241,34 @@ class GPTForCausalLM(Layer):
         self.cfg = cfg
         self.gpt = GPTModel(cfg)
 
-    def forward(self, input_ids, position_ids=None, attn_mask=None):
-        h = self.gpt(input_ids, position_ids, attn_mask)
+    def forward(self, input_ids, position_ids=None, attn_mask=None,
+                cache=None):
         w = self.gpt.embeddings.word_embeddings.weight  # [vocab, hidden]
+        if cache is not None:
+            h, new_cache = self.gpt(input_ids, position_ids, attn_mask,
+                                    cache=cache)
+            return ops.matmul(h, w, transpose_y=True), new_cache
+        h = self.gpt(input_ids, position_ids, attn_mask)
         return ops.matmul(h, w, transpose_y=True)
+
+    def generate(self, prompt_ids, max_new_tokens=32, eos_id=None,
+                 max_len=None, prefill_buckets=None):
+        """Greedy generation through the O(1) static-shape KV cache
+        (:class:`paddle_tpu.serving.GenerationEngine`, batch 1). The
+        engine — and its compiled prefill/decode executables — is cached
+        on the model, so repeated calls never recompile. For concurrent
+        request serving use ``serving.Scheduler`` directly."""
+        from ..serving import GenerationEngine
+
+        key = (max_len, tuple(prefill_buckets) if prefill_buckets else None)
+        eng = getattr(self, "_serve_engine", None)
+        if eng is None or getattr(self, "_serve_engine_key", None) != key:
+            eng = GenerationEngine(self, max_batch=1, max_len=max_len,
+                                   prefill_buckets=prefill_buckets)
+            self._serve_engine = eng
+            self._serve_engine_key = key
+        return eng.generate(prompt_ids, max_new_tokens=max_new_tokens,
+                            eos_id=eos_id)
 
     def loss(self, input_ids, labels):
         """Fused LM loss: head matmul + softmax-CE without materializing the

@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — the inference serving tier.
+
+Static-shape KV-cache autoregressive decode (compile once per length
+bucket for prefill, exactly once for decode — O(1) per generated token)
+plus a slot-based continuous-batching scheduler. See ``kv_cache.py`` for
+the cache/compiler contract, ``engine.py`` for the prefill/decode split,
+``scheduler.py`` for request scheduling, and ``tools/bench_serve.py`` for
+the throughput/latency benchmark.
+"""
+from .kv_cache import (  # noqa: F401
+    KVCache,
+    DecodeView,
+    PrefillView,
+    default_buckets,
+    pick_bucket,
+)
+from .engine import GenerationEngine, EncoderScorer  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+
+__all__ = [
+    "KVCache",
+    "DecodeView",
+    "PrefillView",
+    "default_buckets",
+    "pick_bucket",
+    "GenerationEngine",
+    "EncoderScorer",
+    "Request",
+    "Scheduler",
+]

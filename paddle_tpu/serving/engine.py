@@ -1,0 +1,302 @@
+"""Prefill/decode generation engine over the static-shape KV cache.
+
+Two :class:`~paddle_tpu.jit.functionalize.CompiledStep` programs:
+
+* ``serve_prefill`` — one request's prompt, padded to a length bucket,
+  runs causally and writes its K/V into the request's batch slot. One
+  executable per bucket (telemetry ``compile[serve_prefill]`` == buckets
+  touched), because the bucket width is the ONLY shape that varies — the
+  prompt length, slot index and position are traced scalars.
+* ``serve_decode`` — ONE token per batch slot, every slot at its own
+  position. All shapes are fixed at ``[max_batch, 1]`` + the cache
+  buffers, so this compiles exactly once and its per-step cost is O(1)
+  in generated length.
+
+Both steps thread the model through ``stateful=[model]`` (weights donated
+state, aliased in place) and the cache through ``donate_inputs`` so the
+``dynamic_update_slice`` writes recycle the cache HBM instead of copying
+it — reusing the donation machinery the training pipeline built
+(``jit/functionalize.py``, ``io.DeviceLoader`` contract: a donated batch
+is consumed; the engine rebinds its cache reference after every call).
+
+Also here: :class:`EncoderScorer`, the bucketed compile-once-per-bucket
+serving path for encoder models (BERT sequence scoring).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..jit.functionalize import CompiledStep
+from .kv_cache import (
+    MASK_MIN,
+    DecodeView,
+    KVCache,
+    PrefillView,
+    _leaf,
+    default_buckets,
+    pick_bucket,
+)
+
+__all__ = ["GenerationEngine", "EncoderScorer"]
+
+
+class GenerationEngine:
+    """Serve a decoder-only LM (``GPTForCausalLM``-shaped: callable as
+    ``model(ids, position_ids=, attn_mask=, cache=) -> (logits, cache)``,
+    with a ``cfg`` exposing ``num_layers/num_heads/hidden_size/
+    max_position_embeddings``) with O(1) static-shape decode.
+
+    Args:
+        model: the language model; switched to ``eval()``.
+        max_batch: decode batch width == concurrent request slots.
+        max_len: cache capacity per slot (prompt + generated tokens);
+            defaults to, and may not exceed, the model's position table.
+        prefill_buckets: prompt pad widths; defaults to powers of two up
+            to ``max_len``. One prefill compile per bucket ever touched.
+        cache_dtype: K/V buffer dtype; defaults to the model's embedding
+            weight dtype (bf16 weights → bf16 cache).
+        freeze_weights: fold the weights into the compiled executables as
+            constants instead of threading them as (donated) state.
+            ``"auto"`` (default) freezes on the CPU backend only —
+            measured on XLA:CPU, gemm against an ARGUMENT weight repacks
+            the whole matrix every call (a batch≥2 gpt2-124M decode step:
+            ~500 ms vs ~120 ms frozen; batch-1 takes the gemv path and
+            never repacks), while constants are packed once at compile.
+            On TPU the trade flips: constants are duplicated into every
+            per-bucket executable (the ``hbm-const-folded`` lint hazard),
+            so weights stay threaded state there. A frozen engine
+            snapshots the weights at compile — rebuild it after updating
+            the model.
+    """
+
+    def __init__(self, model, *, max_batch=8, max_len=None,
+                 prefill_buckets=None, cache_dtype=None,
+                 freeze_weights="auto"):
+        cfg = model.cfg
+        model.eval()
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_len = int(max_len or cfg.max_position_embeddings)
+        if self.max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's position "
+                f"table ({cfg.max_position_embeddings})")
+        self.prefill_buckets = tuple(sorted(
+            int(b) for b in (prefill_buckets
+                             or default_buckets(self.max_len))))
+        if self.prefill_buckets[-1] > self.max_len:
+            raise ValueError(
+                f"prefill bucket {self.prefill_buckets[-1]} exceeds "
+                f"max_len={self.max_len}")
+        self.num_layers = cfg.num_layers
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        if cache_dtype is None:
+            w = model.gpt.embeddings.word_embeddings.weight
+            cache_dtype = _leaf(w).dtype
+        self.cache_dtype = jnp.dtype(cache_dtype)
+        self.cache = KVCache.alloc(
+            self.num_layers, self.max_batch, self.max_len,
+            self.num_heads, self.head_dim, self.cache_dtype)
+        if freeze_weights == "auto":
+            freeze_weights = jax.default_backend() == "cpu"
+        self.freeze_weights = bool(freeze_weights)
+        stateful = [] if self.freeze_weights else [model]
+        self._prefill_step = CompiledStep(
+            self._make_prefill(), stateful=stateful, donate_state=True,
+            donate_inputs=["args[3]"])
+        self._decode_step = CompiledStep(
+            self._make_decode(), stateful=stateful, donate_state=True,
+            donate_inputs=["args[1]"])
+
+    # -- traced step bodies --------------------------------------------------
+    def _make_prefill(self):
+        model = self.model
+        max_len = self.max_len
+
+        def serve_prefill(tokens, length, slot, cache):
+            # tokens [1, bucket] int32; length/slot traced 0-d int32
+            ln = _leaf(length).astype(jnp.int32)
+            sl = _leaf(slot).astype(jnp.int32)
+            bucket = tokens.shape[1]
+            i = jnp.arange(bucket, dtype=jnp.int32)
+            # causal within the chunk AND key < prompt length: padded tail
+            # queries produce garbage logits which are never read (the last
+            # valid position is sliced out below)
+            valid = (i[None, :] <= i[:, None]) & (i[None, :] < ln)
+            mask = jnp.where(valid, 0.0, MASK_MIN)[None, None, :, :]
+            mask = mask.astype(jnp.float32)
+            views = [PrefillView(cache.ks[l], cache.vs[l], sl)
+                     for l in range(len(cache.ks))]
+            logits, views = model(
+                tokens, position_ids=Tensor(i[None, :]),
+                attn_mask=Tensor(mask), cache=views)
+            lv = _leaf(logits)  # [1, bucket, vocab]
+            # next-token logits live at the last VALID position, not the
+            # padded chunk end — a traced dynamic_slice keeps it shape-stable
+            last = jax.lax.dynamic_slice(
+                lv, (jnp.int32(0), ln - 1, jnp.int32(0)),
+                (1, 1, lv.shape[-1]))[0, 0]
+            next_tok = jnp.argmax(last).astype(jnp.int32)
+            new_len = jax.lax.dynamic_update_slice(
+                _leaf(cache.lengths), jnp.minimum(ln, max_len)[None], (sl,))
+            new_cache = KVCache(tuple(v.k for v in views),
+                                tuple(v.v for v in views), new_len)
+            return Tensor(next_tok), new_cache
+
+        return serve_prefill
+
+    def _make_decode(self):
+        model = self.model
+        max_len = self.max_len
+
+        def serve_decode(tokens, cache):
+            # tokens [max_batch, 1] int32 — each slot's last token, fed at
+            # that slot's own position; shapes NEVER vary step to step
+            ln = _leaf(cache.lengths).astype(jnp.int32)
+            pos = jnp.minimum(ln, max_len - 1)  # [b]
+            keys = jnp.arange(max_len, dtype=jnp.int32)
+            valid = keys[None, :] <= pos[:, None]  # [b, max_len]
+            mask = jnp.where(valid, 0.0, MASK_MIN).astype(jnp.float32)
+            mask = mask[:, None, None, :]  # [b, 1, 1, max_len]
+            views = [DecodeView(cache.ks[l], cache.vs[l], pos)
+                     for l in range(len(cache.ks))]
+            logits, views = model(
+                tokens, position_ids=Tensor(pos[:, None]),
+                attn_mask=Tensor(mask), cache=views)
+            last = _leaf(logits)[:, -1]  # [b, vocab]
+            # greedy argmax ON DEVICE: only [b] int32 crosses back to the
+            # host per step, never the [b, vocab] logits
+            next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            new_cache = KVCache(tuple(v.k for v in views),
+                                tuple(v.v for v in views),
+                                Tensor(ln + 1))
+            return Tensor(next_tok), new_cache
+
+        return serve_decode
+
+    # -- host-side API -------------------------------------------------------
+    def prefill(self, slot, prompt_ids):
+        """Prefill ``prompt_ids`` into batch slot ``slot``; returns the
+        greedy next token (host int). Host↔device: one tiny token readback
+        per request — the batched decode loop carries the heavy traffic."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"generate within max_len={self.max_len}")
+        if not (0 <= int(slot) < self.max_batch):
+            raise ValueError(f"slot {slot} outside [0, {self.max_batch})")
+        bucket = pick_bucket(prompt.size, self.prefill_buckets)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :prompt.size] = prompt
+        tok, cache = self._prefill_step(
+            toks, np.int32(prompt.size), np.int32(slot), self.cache)
+        self.cache = cache  # donated: the old buffers are consumed
+        return int(np.asarray(_leaf(tok)))
+
+    def decode_once(self, last_tokens):
+        """One batched decode step: ``last_tokens[b]`` is each slot's most
+        recent token. Returns the next token per slot (np int32 [b])."""
+        feed = np.asarray(last_tokens, np.int32).reshape(self.max_batch, 1)
+        tok, cache = self._decode_step(feed, self.cache)
+        self.cache = cache
+        return np.asarray(_leaf(tok))
+
+    def generate(self, prompt_ids, max_new_tokens=32, eos_id=None):
+        """Greedy single-request generation (slot 0; other slots idle).
+        Per-step cost is O(1) in generated length: one ``serve_decode``
+        dispatch, no recompiles, no cache copies."""
+        out = [self.prefill(0, prompt_ids)]
+        while len(out) < int(max_new_tokens):
+            if eos_id is not None and out[-1] == eos_id:
+                break
+            feed = np.zeros((self.max_batch,), np.int32)
+            feed[0] = out[-1]
+            out.append(int(self.decode_once(feed)[0]))
+        return out
+
+    def lengths(self):
+        """Per-slot cached-token counts (host numpy)."""
+        return np.asarray(_leaf(self.cache.lengths))
+
+    @property
+    def decode_step(self):
+        """The compiled decode step — exposed for graph-lint
+        (``analysis.lint_step(engine.decode_step, tokens, cache, ...)``)."""
+        return self._decode_step
+
+    @property
+    def prefill_step(self):
+        return self._prefill_step
+
+    def example_decode_args(self, lengths):
+        """A shape-faithful (tokens, cache) example batch for static lint:
+        fresh (non-donated) cache buffers with the given per-slot lengths.
+        Two consecutive positions lint identically — that IS the O(1)
+        contract the ``kv-cache-concat`` rule checks."""
+        ln = np.zeros((self.max_batch,), np.int32)
+        ln[:len(lengths)] = np.asarray(lengths, np.int32)
+        cache = KVCache.alloc(self.num_layers, self.max_batch, self.max_len,
+                              self.num_heads, self.head_dim, self.cache_dtype)
+        cache = KVCache(cache.ks, cache.vs, jnp.asarray(ln))
+        tokens = np.zeros((self.max_batch, 1), np.int32)
+        return tokens, cache
+
+
+class EncoderScorer:
+    """Bucketed batch scoring for encoder models (BERT classification).
+
+    Pads requests to ``[max_batch, seq_bucket]`` so one ``serve_score``
+    executable per sequence bucket serves every request mix — the serving
+    analogue of the decoder engine's prefill bucketing (no KV cache:
+    encoders are single-shot).
+    """
+
+    def __init__(self, model, *, max_batch=8, seq_buckets=None,
+                 max_seq=None, freeze_weights="auto"):
+        model.eval()
+        self.model = model
+        self.max_batch = int(max_batch)
+        cfg = getattr(model, "cfg", None) or model.bert.cfg
+        self.max_seq = int(max_seq or cfg.max_position_embeddings)
+        self.seq_buckets = tuple(sorted(
+            int(b) for b in (seq_buckets or default_buckets(self.max_seq))))
+        if freeze_weights == "auto":  # same trade as GenerationEngine
+            freeze_weights = jax.default_backend() == "cpu"
+        self.freeze_weights = bool(freeze_weights)
+
+        def serve_score(ids, mask):
+            return model(ids, attention_mask=mask)
+
+        self._step = CompiledStep(
+            serve_score, stateful=[] if self.freeze_weights else [model],
+            donate_state=True)
+
+    def score(self, sequences):
+        """Score a list of token-id sequences; returns ``[n, classes]``
+        numpy logits. Requests are chunked to ``max_batch`` and padded to
+        the smallest bucket that fits the chunk's longest sequence."""
+        seqs = [np.asarray(s, np.int32).reshape(-1) for s in sequences]
+        outs = []
+        for lo in range(0, len(seqs), self.max_batch):
+            chunk = seqs[lo:lo + self.max_batch]
+            bucket = pick_bucket(max(len(s) for s in chunk),
+                                 self.seq_buckets)
+            ids = np.zeros((self.max_batch, bucket), np.int32)
+            mask = np.zeros((self.max_batch, bucket), np.float32)
+            for i, s in enumerate(chunk):
+                ids[i, :len(s)] = s
+                mask[i, :len(s)] = 1.0
+            logits = self._step(ids, mask)
+            outs.append(np.asarray(_leaf(logits))[:len(chunk)])
+        return np.concatenate(outs, axis=0)
+
+    @property
+    def step(self):
+        return self._step

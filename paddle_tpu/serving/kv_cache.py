@@ -1,0 +1,210 @@
+"""Static-shape KV cache: O(1) autoregressive decode on XLA.
+
+The legacy decode path (``models/gpt.py`` tuple cache) grew K/V with
+``ops.concat`` every step — each step changes the cache operand shape, so
+XLA compiles ONE EXECUTABLE PER POSITION (the exact hazard the
+``retrace-shape-churn`` / ``kv-cache-concat`` lint rules flag) and the
+concat re-materializes the full cache in HBM every token: O(n) per step,
+O(n²) per sequence.
+
+This module is the compiler-first formulation (PAPERS.md arxiv 2603.09555):
+per-layer buffers are preallocated at ``[batch, max_len, heads, head_dim]``
+and every step writes the new K/V rows with ``lax.dynamic_update_slice`` at
+a *traced* position index — the shapes entering the compiled step never
+change, so prefill compiles once per length bucket and decode compiles
+exactly once, and with the buffers passed through ``CompiledStep``'s
+``donate_inputs`` the update aliases in place in HBM (arxiv 2301.13062:
+a fused in-place dynamic-update-slice, not a gather/concat chain).
+
+Masking carries the variable part: attention always runs over the full
+``max_len`` keys and an additive mask built from the per-slot lengths
+zeroes out the not-yet-written tail. Correctness invariant: position ``j``
+of a slot's buffer holds garbage only while ``j >= length`` — and the mask
+admits exactly ``j <= position-of-the-query`` — so garbage is never
+attended to and is overwritten the moment the sequence reaches it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["KVCache", "DecodeView", "PrefillView", "pick_bucket",
+           "default_buckets"]
+
+#: additive-mask floor: large enough to zero a softmax lane in fp32/bf16
+#: without producing inf-inf NaNs when a whole row is masked
+MASK_MIN = -1e9
+
+
+def _leaf(x):
+    """Tensor -> backing array; arrays pass through."""
+    return x._value if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# length bucketing
+# ---------------------------------------------------------------------------
+def default_buckets(max_len, min_bucket=16):
+    """Powers-of-two prefill widths ``min_bucket .. max_len`` (inclusive
+    when ``max_len`` is itself reachable). One compiled prefill executable
+    per bucket serves every prompt length ≤ that bucket."""
+    max_len = int(max_len)
+    b = int(min_bucket)
+    out = []
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return tuple(out)
+
+
+def pick_bucket(n, buckets):
+    """Smallest bucket that fits ``n`` tokens (compile-once-per-bucket)."""
+    for b in sorted(buckets):
+        if n <= b:
+            return int(b)
+    raise ValueError(
+        f"sequence of {n} tokens exceeds the largest prefill bucket "
+        f"{max(buckets)}; raise max_len/prefill_buckets on the engine")
+
+
+# ---------------------------------------------------------------------------
+# the cache pytree
+# ---------------------------------------------------------------------------
+@jax.tree_util.register_pytree_node_class
+class KVCache:
+    """Per-layer static K/V buffers + per-slot valid lengths.
+
+    A registered pytree, so it threads straight through ``CompiledStep``
+    arguments (and its leaves can be donated with
+    ``donate_inputs=["args[i]"]`` — every leaf path under the cache
+    argument matches the prefix). ``lengths[i]`` is the number of valid
+    cached tokens in batch slot ``i``; buffers beyond it are garbage by
+    contract (masked until overwritten).
+
+    Layout: ``ks[layer] / vs[layer]: [batch, max_len, heads, head_dim]``,
+    ``lengths: [batch] int32``.
+    """
+
+    __slots__ = ("ks", "vs", "lengths")
+
+    def __init__(self, ks, vs, lengths):
+        self.ks = tuple(ks)
+        self.vs = tuple(vs)
+        self.lengths = lengths
+
+    def tree_flatten(self):
+        return ((self.ks, self.vs, self.lengths), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    @classmethod
+    def alloc(cls, num_layers, batch, max_len, num_heads, head_dim,
+              dtype=jnp.float32):
+        shape = (int(batch), int(max_len), int(num_heads), int(head_dim))
+        ks = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+        vs = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+        return cls(ks, vs, jnp.zeros((int(batch),), jnp.int32))
+
+    # shape accessors read through Tensor leaves (inside a traced step the
+    # leaves are Tensors wrapping tracers; outside, jax arrays)
+    @property
+    def num_layers(self):
+        return len(self.ks)
+
+    @property
+    def batch(self):
+        return int(_leaf(self.ks[0]).shape[0])
+
+    @property
+    def max_len(self):
+        return int(_leaf(self.ks[0]).shape[1])
+
+    @property
+    def num_heads(self):
+        return int(_leaf(self.ks[0]).shape[2])
+
+    @property
+    def head_dim(self):
+        return int(_leaf(self.ks[0]).shape[3])
+
+    def nbytes(self):
+        k = _leaf(self.ks[0])
+        per = k.size * jnp.dtype(k.dtype).itemsize
+        return 2 * self.num_layers * int(per)
+
+    def __repr__(self):
+        k = _leaf(self.ks[0])
+        return (f"KVCache(layers={self.num_layers}, "
+                f"shape={tuple(k.shape)}, dtype={k.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# per-layer views (the duck-typed `cache=` object GPTDecoderLayer consumes)
+# ---------------------------------------------------------------------------
+def _row_update(buf, new, starts):
+    """Batched in-place row write: ``buf[i, starts[i]:starts[i]+s] = new[i]``
+    via a vmapped ``dynamic_update_slice`` (per-slot scalar start index,
+    static shapes — XLA lowers this to one fused in-place update when the
+    buffer is donated)."""
+
+    def one(b, n, s):
+        z = jnp.int32(0)
+        return jax.lax.dynamic_update_slice(b, n, (s.astype(jnp.int32), z, z))
+
+    return jax.vmap(one)(buf, new, starts)
+
+
+class DecodeView:
+    """One layer's cache view for the batched decode step.
+
+    ``update(k_new, v_new)`` writes each slot's single new K/V row at that
+    slot's position index and returns the FULL buffers for attention (the
+    additive length mask hides the invalid tail). The updated buffers stay
+    on the view; the engine collects them into the next ``KVCache``.
+    """
+
+    __slots__ = ("k", "v", "pos")
+
+    def __init__(self, k, v, pos):
+        self.k = _leaf(k)
+        self.v = _leaf(v)
+        self.pos = _leaf(pos)
+
+    def update(self, k_new, v_new):
+        kn = _leaf(k_new).astype(self.k.dtype)
+        vn = _leaf(v_new).astype(self.v.dtype)
+        self.k = _row_update(self.k, kn, self.pos)
+        self.v = _row_update(self.v, vn, self.pos)
+        return Tensor(self.k), Tensor(self.v), self
+
+
+class PrefillView:
+    """One layer's cache view for the single-request prefill step.
+
+    The prompt chunk's K/V are written into batch row ``slot`` (positions
+    ``0..chunk-1``) and the CHUNK tensors are returned for attention — a
+    fresh slot has no prior context, so causal attention over the padded
+    chunk (with the padding masked by the caller's mask) is exact.
+    """
+
+    __slots__ = ("k", "v", "slot")
+
+    def __init__(self, k, v, slot):
+        self.k = _leaf(k)
+        self.v = _leaf(v)
+        self.slot = _leaf(slot)
+
+    def update(self, k_new, v_new):
+        kn = _leaf(k_new).astype(self.k.dtype)
+        vn = _leaf(v_new).astype(self.v.dtype)
+        z = jnp.int32(0)
+        start = (self.slot.astype(jnp.int32), z, z, z)
+        self.k = jax.lax.dynamic_update_slice(self.k, kn, start)
+        self.v = jax.lax.dynamic_update_slice(self.v, vn, start)
+        return k_new, v_new, self

@@ -1,0 +1,219 @@
+"""Continuous batching: slot-based request scheduling over the engine.
+
+The "heavy traffic from millions of users" workload (ROADMAP north star):
+requests arrive continuously, and the decode batch must stay DENSE — a
+finished sequence's slot is handed to the next queued request instead of
+waiting for the whole batch to drain (the static-batch waste). Each
+scheduler ``step()``:
+
+1. **admit** — pop queued requests into free slots (FIFO, lowest slot
+   first: deterministic given a deterministic arrival stream) and prefill
+   each prompt into its slot;
+2. **decode** — ONE batched ``serve_decode`` over every active slot;
+3. **evict** — retire sequences that hit EOS or their token budget,
+   freeing their slots for the next admit.
+
+Everything observable goes through the existing telemetry registry
+(``profiler/telemetry.py``): ``serve.requests_in_flight`` /
+``serve.queue_depth`` gauges, ``serve.admitted`` / ``serve.evicted`` /
+``serve.tokens_generated`` / ``serve.decode_steps`` / ``serve.slot_steps``
+counters, and per-request ``serve.ttft_s`` / ``serve.tpot_s`` /
+``serve.latency_s`` histograms — ``tools/bench_serve.py`` summarizes them
+into the SERVE json.
+
+Determinism contract (regression-tested): with a fixed arrival stream and
+seeded model, the admit/evict event log and every generated sequence are
+identical run to run — slots are a min-heap, the active set is iterated in
+slot order, and decoding is greedy.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..profiler import telemetry as _telemetry
+
+__all__ = ["Request", "Scheduler"]
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request plus its serving lifecycle record."""
+
+    prompt: list
+    max_new_tokens: int = 32
+    eos_id: int | None = None
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+
+    # lifecycle (ns timestamps on time.perf_counter_ns)
+    tokens: list = field(default_factory=list)
+    slot: int | None = None
+    submit_ns: int | None = None
+    first_token_ns: int | None = None
+    done_ns: int | None = None
+    finish_reason: str | None = None
+
+    @property
+    def finished(self):
+        return self.done_ns is not None
+
+    @property
+    def ttft_s(self):
+        """Time to first token (submit → prefill's token readback)."""
+        if self.first_token_ns is None or self.submit_ns is None:
+            return None
+        return (self.first_token_ns - self.submit_ns) / 1e9
+
+    @property
+    def tpot_s(self):
+        """Mean time per output token after the first."""
+        if not self.finished or len(self.tokens) < 2:
+            return None
+        return ((self.done_ns - self.first_token_ns)
+                / (len(self.tokens) - 1) / 1e9)
+
+    @property
+    def latency_s(self):
+        if not self.finished:
+            return None
+        return (self.done_ns - self.submit_ns) / 1e9
+
+
+class Scheduler:
+    """Slot-based continuous-batching scheduler over a
+    :class:`~paddle_tpu.serving.GenerationEngine`."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.queue = deque()
+        self.active = {}  # slot -> Request
+        self.finished = []
+        self.events = []  # (step_idx, "admit"|"evict", rid, slot)
+        self._free = list(range(engine.max_batch))
+        heapq.heapify(self._free)
+        self._step_idx = 0
+        self.decode_steps = 0
+        self.slot_steps = 0
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, request: Request):
+        """Queue a request. Validated against the engine's capacity up
+        front so a doomed request fails at submit, not mid-serve."""
+        n = len(request.prompt)
+        if n == 0:
+            raise ValueError("empty prompt")
+        if n > self.engine.prefill_buckets[-1]:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill bucket "
+                f"{self.engine.prefill_buckets[-1]}")
+        if n + request.max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"prompt ({n}) + max_new_tokens ({request.max_new_tokens}) "
+                f"exceeds the cache capacity max_len={self.engine.max_len}")
+        request.submit_ns = time.perf_counter_ns()
+        self.queue.append(request)
+        if _telemetry.enabled():
+            tm = _telemetry.get_telemetry()
+            tm.inc("serve.submitted")
+            tm.set_gauge("serve.queue_depth", len(self.queue))
+        return request
+
+    # -- the serving loop ----------------------------------------------------
+    def step(self):
+        """One scheduler tick: admit → batched decode → evict. Returns the
+        requests that finished during this tick."""
+        tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        done_now = []
+
+        # admit: fill free slots from the queue (FIFO, lowest slot first)
+        while self.queue and self._free:
+            req = self.queue.popleft()
+            slot = heapq.heappop(self._free)
+            req.slot = slot
+            tok = self.engine.prefill(slot, req.prompt)
+            req.first_token_ns = time.perf_counter_ns()
+            req.tokens.append(tok)
+            self.active[slot] = req
+            self.events.append((self._step_idx, "admit", req.rid, slot))
+            if tm is not None:
+                tm.inc("serve.admitted")
+                tm.inc("serve.prefill_tokens", len(req.prompt))
+                tm.inc("serve.tokens_generated")
+            if self._exhausted(req):
+                done_now.append(self._evict(req))
+
+        # decode: one batched step over every active slot
+        if self.active:
+            feed = np.zeros((self.engine.max_batch,), np.int32)
+            for slot, req in self.active.items():
+                feed[slot] = req.tokens[-1]
+            out = self.engine.decode_once(feed)
+            self.decode_steps += 1
+            self.slot_steps += len(self.active)
+            if tm is not None:
+                tm.inc("serve.decode_steps")
+                tm.inc("serve.slot_steps", len(self.active))
+                tm.inc("serve.tokens_generated", len(self.active))
+            for slot in sorted(self.active):
+                req = self.active[slot]
+                req.tokens.append(int(out[slot]))
+                if self._exhausted(req):
+                    done_now.append(self._evict(req))
+
+        self._step_idx += 1
+        if tm is not None:
+            tm.set_gauge("serve.requests_in_flight", len(self.active))
+            tm.set_gauge("serve.queue_depth", len(self.queue))
+        return done_now
+
+    def run(self, max_steps=None):
+        """Drive ``step()`` until the queue and the batch drain (or
+        ``max_steps`` ticks elapse); returns all finished requests."""
+        steps = 0
+        while self.queue or self.active:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.finished
+
+    # -- bookkeeping ---------------------------------------------------------
+    def _exhausted(self, req):
+        if req.eos_id is not None and req.tokens[-1] == req.eos_id:
+            req.finish_reason = "eos"
+            return True
+        if len(req.tokens) >= req.max_new_tokens:
+            req.finish_reason = "length"
+            return True
+        return False
+
+    def _evict(self, req):
+        req.done_ns = time.perf_counter_ns()
+        self.active.pop(req.slot, None)
+        heapq.heappush(self._free, req.slot)
+        self.events.append((self._step_idx, "evict", req.rid, req.slot))
+        self.finished.append(req)
+        if _telemetry.enabled():
+            tm = _telemetry.get_telemetry()
+            tm.inc("serve.evicted")
+            if req.ttft_s is not None:
+                tm.observe("serve.ttft_s", req.ttft_s)
+            if req.tpot_s is not None:
+                tm.observe("serve.tpot_s", req.tpot_s)
+            if req.latency_s is not None:
+                tm.observe("serve.latency_s", req.latency_s)
+        return req
+
+    def occupancy(self):
+        """Mean decode-batch occupancy: active slots per decode step over
+        the batch width (1.0 = the decode batch stayed dense)."""
+        if not self.decode_steps:
+            return 0.0
+        return self.slot_steps / (self.decode_steps * self.engine.max_batch)

@@ -1,0 +1,397 @@
+"""Inference serving tier (ISSUE 6): static-shape KV-cache decode +
+continuous batching.
+
+Contracts under test:
+  * cached-vs-uncached greedy parity — 32 tokens of greedy decode through
+    the static KV cache produce the SAME token ids as the uncached full
+    forward, for bucket-boundary and mid-bucket prompt lengths;
+  * O(1) decode — telemetry compile counters over a 64+-token generation:
+    decode compiles EXACTLY once, prefill once per length bucket;
+  * static lint — the decode step at two consecutive positions carries
+    zero shape-churn/kv-cache findings, while the legacy grow-by-concat
+    gpt cache path is flagged by the `kv-cache-concat` rule;
+  * continuous batching — admit/evict determinism under a seeded arrival
+    stream, per-request output parity with single-request generate, and
+    dense-batch occupancy accounting.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.models import (
+    BertConfig,
+    BertForSequenceClassification,
+    GPTConfig,
+    GPTDecoderLayer,
+    GPTForCausalLM,
+)
+from paddle_tpu.profiler import telemetry
+from paddle_tpu.serving import (
+    GenerationEngine,
+    KVCache,
+    Request,
+    Scheduler,
+    default_buckets,
+    pick_bucket,
+)
+from paddle_tpu.utils import unique_name
+
+
+@pytest.fixture
+def _no_persistent_compile_cache():
+    """Parity tests compare a cached-decode executable against a fresh
+    eager path: executables round-tripped through the persistent XLA:CPU
+    compile cache are not bit-identical to in-process compiles on this
+    stack (see tests/test_fault_tolerance.py and the conftest warm-cache
+    hazard note — the eager BERT path comes back corrupted on a warm
+    cache), so these tests compile everything in-process."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def _gpt_cfg(max_pos=128):
+    return GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                     num_heads=2, max_position_embeddings=max_pos,
+                     hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def _gpt(seed=0, max_pos=128):
+    with unique_name.guard():
+        paddle.seed(seed)
+        model = GPTForCausalLM(_gpt_cfg(max_pos))
+    model.eval()
+    return model
+
+
+def _greedy_eager(model, prompt, n):
+    """Uncached reference: full forward over the growing sequence."""
+    ids = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model(Tensor(np.asarray(ids, np.int64)[None, :]))
+        nxt = int(np.asarray(logits._value)[0, -1].argmax())
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bucketing + cache plumbing
+# ---------------------------------------------------------------------------
+def test_bucket_helpers():
+    assert default_buckets(64) == (16, 32, 64)
+    assert default_buckets(100) == (16, 32, 64, 100)
+    assert pick_bucket(1, (8, 16)) == 8
+    assert pick_bucket(8, (8, 16)) == 8   # boundary stays in its bucket
+    assert pick_bucket(9, (8, 16)) == 16
+    with pytest.raises(ValueError, match="largest prefill bucket"):
+        pick_bucket(17, (8, 16))
+
+
+def test_kv_cache_alloc_layout():
+    c = KVCache.alloc(num_layers=3, batch=2, max_len=16, num_heads=4,
+                      head_dim=8)
+    assert c.num_layers == 3 and c.batch == 2 and c.max_len == 16
+    assert c.num_heads == 4 and c.head_dim == 8
+    assert c.ks[0].shape == (2, 16, 4, 8)
+    assert c.lengths.dtype.name == "int32"
+    # 3 layers x (K+V) x 2*16*4*8 floats
+    assert c.nbytes() == 3 * 2 * 2 * 16 * 4 * 8 * 4
+    # a registered pytree: flattens/unflattens through jax
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(c)
+    assert len(leaves) == 3 * 2 + 1
+    assert isinstance(jax.tree_util.tree_unflatten(treedef, leaves), KVCache)
+
+
+# ---------------------------------------------------------------------------
+# cached-vs-uncached greedy parity (the correctness tentpole)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("prompt_len", [5, 8])  # mid-bucket / boundary
+def test_cached_greedy_parity_32_tokens(prompt_len,
+                                        _no_persistent_compile_cache):
+    model = _gpt()
+    prompt = np.random.RandomState(7).randint(0, 97, prompt_len).tolist()
+    eng = GenerationEngine(model, max_batch=2, max_len=64,
+                           prefill_buckets=(8, 16))
+    got = eng.generate(prompt, max_new_tokens=32)
+    want = _greedy_eager(model, prompt, 32)
+    assert got == want
+
+
+def test_generate_convenience_on_model_caches_engine(
+        _no_persistent_compile_cache):
+    model = _gpt()
+    prompt = [3, 1, 4, 1, 5]
+    got = model.generate(prompt, max_new_tokens=8, max_len=64,
+                         prefill_buckets=(8,))
+    assert got == _greedy_eager(model, prompt, 8)
+    eng = model._serve_engine
+    # second call reuses the cached engine (and its compiled executables)
+    model.generate(prompt, max_new_tokens=4, max_len=64,
+                   prefill_buckets=(8,))
+    assert model._serve_engine is eng
+
+
+def test_generate_stops_at_eos():
+    model = _gpt()
+    eng = GenerationEngine(model, max_batch=1, max_len=64,
+                           prefill_buckets=(8,))
+    free_run = eng.generate([1, 2, 3], max_new_tokens=8)
+    eos = free_run[1]
+    out = eng.generate([1, 2, 3], max_new_tokens=8, eos_id=eos)
+    # greedy is deterministic: stops right after the FIRST eos emission
+    assert out == free_run[:free_run.index(eos) + 1]
+    assert out[-1] == eos and len(out) < 8
+
+
+# ---------------------------------------------------------------------------
+# O(1) decode: compile counters + static lint
+# ---------------------------------------------------------------------------
+def test_decode_compiles_once_over_64_tokens():
+    model = _gpt()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        eng = GenerationEngine(model, max_batch=2, max_len=128,
+                               prefill_buckets=(8, 16))
+        out = eng.generate([5, 6, 7], max_new_tokens=65)
+        counts = telemetry.get_telemetry().compile_counts()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert len(out) == 65
+    assert counts.get("serve_decode") == 1, counts  # 64 steps, ONE compile
+    assert counts.get("serve_prefill") == 1, counts  # one bucket touched
+
+
+def test_prefill_compiles_once_per_bucket():
+    model = _gpt()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        eng = GenerationEngine(model, max_batch=2, max_len=64,
+                               prefill_buckets=(8, 16))
+        eng.generate([1] * 5, max_new_tokens=3)    # bucket 8
+        eng.generate([1] * 12, max_new_tokens=3)   # bucket 16
+        eng.generate([1] * 7, max_new_tokens=3)    # bucket 8 again: cached
+        counts = telemetry.get_telemetry().compile_counts()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert counts.get("serve_prefill") == 2, counts
+    assert counts.get("serve_decode") == 1, counts
+
+
+def test_decode_lint_clean_at_consecutive_positions():
+    model = _gpt()
+    eng = GenerationEngine(model, max_batch=2, max_len=32,
+                           prefill_buckets=(8,))
+    a1 = eng.example_decode_args([5, 3])
+    a2 = eng.example_decode_args([6, 4])
+    report = analysis.lint_step(eng.decode_step, *a1, extra_args=[a2])
+    churn = [f for f in report
+             if f.rule in ("retrace-shape-churn", "kv-cache-concat")]
+    assert not churn, report.table()
+    assert not report.errors, report.table()
+
+
+def test_kv_cache_concat_rule_flags_legacy_gpt_cache():
+    """Regression fixture: the pre-fix grow-by-concat tuple cache — the
+    cache operands change shape between consecutive positions and come
+    back one step larger, which is exactly the `kv-cache-concat`
+    signature. The rule must name the cache paths and point at
+    serving.KVCache."""
+    cfg = _gpt_cfg(max_pos=32)
+    with unique_name.guard():
+        paddle.seed(0)
+        layer = GPTDecoderLayer(cfg)
+    layer.eval()
+
+    def legacy_decode(x, k, v):
+        out, cache = layer(x, cache=(k, v))
+        return out, cache[0], cache[1]
+
+    x = np.random.RandomState(0).randn(1, 1, cfg.hidden_size)
+    x = x.astype(np.float32)
+
+    def kv(t):
+        shape = (1, t, cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+        return (np.zeros(shape, np.float32), np.zeros(shape, np.float32))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        report = analysis.lint_step(legacy_decode, x, *kv(5),
+                                    extra_args=[(x,) + kv(6)])
+    findings = [f for f in report if f.rule == "kv-cache-concat"]
+    assert {f.path for f in findings} == {"args[1]", "args[2]"}
+    assert all(f.severity == "error" for f in findings)
+    assert "serving.KVCache" in findings[0].hint
+    # a shape-stable signature stays silent (no variants disagree)
+    clean = analysis.lint_step(legacy_decode, x, *kv(5),
+                               extra_args=[(x,) + kv(5)])
+    assert not [f for f in clean if f.rule == "kv-cache-concat"]
+
+
+def test_tuple_cache_shim_still_works_and_warns_once():
+    from paddle_tpu.utils import _WARNED_ONCE
+
+    cfg = _gpt_cfg(max_pos=32)
+    with unique_name.guard():
+        paddle.seed(0)
+        layer = GPTDecoderLayer(cfg)
+    layer.eval()
+    _WARNED_ONCE.discard("gpt-kv-cache-concat")
+    hd = cfg.hidden_size // cfg.num_heads
+    k0 = Tensor(np.zeros((1, 3, cfg.num_heads, hd), np.float32))
+    v0 = Tensor(np.zeros((1, 3, cfg.num_heads, hd), np.float32))
+    x = Tensor(np.random.RandomState(0).randn(1, 1, cfg.hidden_size)
+               .astype(np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out, cache = layer(x, cache=(k0, v0))
+        out2, cache2 = layer(x, cache=cache)
+    msgs = [str(x.message) for x in w]
+    assert sum("deprecated" in m for m in msgs) == 1  # warns ONCE
+    assert tuple(cache[0].shape) == (1, 4, cfg.num_heads, hd)   # grew...
+    assert tuple(cache2[0].shape) == (1, 5, cfg.num_heads, hd)  # ...again
+    assert tuple(out2.shape) == (1, 1, cfg.hidden_size)
+
+
+# ---------------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------------
+def _request_stream(seed, n, vocab=97):
+    rng = np.random.RandomState(seed)
+    return [Request(prompt=rng.randint(0, vocab,
+                                       int(rng.randint(3, 14))).tolist(),
+                    max_new_tokens=int(rng.randint(4, 12)), rid=i)
+            for i in range(n)]
+
+
+def _run_stream(seed):
+    model = _gpt(seed=3, max_pos=64)
+    eng = GenerationEngine(model, max_batch=4, max_len=64,
+                           prefill_buckets=(8, 16))
+    sched = Scheduler(eng)
+    for req in _request_stream(seed, 9):
+        sched.submit(req)
+    finished = sched.run()
+    return sched, {r.rid: list(r.tokens) for r in finished}
+
+
+def test_scheduler_admit_evict_deterministic():
+    s1, out1 = _run_stream(11)
+    s2, out2 = _run_stream(11)
+    assert s1.events == s2.events  # identical admit/evict log
+    assert out1 == out2
+    assert len(out1) == 9
+    # slots were actually recycled: more admits than batch slots
+    admits = [e for e in s1.events if e[1] == "admit"]
+    assert len(admits) == 9 > s1.engine.max_batch
+    assert 0.0 < s1.occupancy() <= 1.0
+
+
+def test_scheduler_matches_single_request_generate(
+        _no_persistent_compile_cache):
+    """Continuous batching with slot churn produces the SAME tokens per
+    request as serving each request alone — cross-slot isolation."""
+    model = _gpt(seed=3, max_pos=64)
+    eng = GenerationEngine(model, max_batch=3, max_len=64,
+                           prefill_buckets=(8, 16))
+    sched = Scheduler(eng)
+    reqs = _request_stream(5, 7)
+    for r in reqs:
+        sched.submit(r)
+    sched.run()
+    solo = GenerationEngine(model, max_batch=1, max_len=64,
+                            prefill_buckets=(8, 16))
+    for r in reqs:
+        want = solo.generate(r.prompt, max_new_tokens=r.max_new_tokens)
+        assert r.tokens == want, f"request {r.rid} diverged"
+        assert r.finish_reason == "length"
+        assert r.ttft_s is not None and r.latency_s is not None
+
+
+def test_scheduler_rejects_oversized_requests():
+    model = _gpt(max_pos=64)
+    eng = GenerationEngine(model, max_batch=2, max_len=32,
+                           prefill_buckets=(8, 16))
+    sched = Scheduler(eng)
+    with pytest.raises(ValueError, match="prefill bucket"):
+        sched.submit(Request(prompt=[1] * 20, max_new_tokens=4))
+    with pytest.raises(ValueError, match="cache capacity"):
+        sched.submit(Request(prompt=[1] * 10, max_new_tokens=30))
+    with pytest.raises(ValueError, match="empty prompt"):
+        sched.submit(Request(prompt=[], max_new_tokens=4))
+
+
+def test_scheduler_publishes_telemetry():
+    model = _gpt(max_pos=64)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        eng = GenerationEngine(model, max_batch=2, max_len=64,
+                               prefill_buckets=(8, 16))
+        sched = Scheduler(eng)
+        for r in _request_stream(2, 4):
+            r.max_new_tokens = 4
+            sched.submit(r)
+        sched.run()
+        tm = telemetry.get_telemetry()
+        counters, gauges = tm.counters(), tm.gauges()
+        ttft = tm.get("serve.ttft_s")
+        latency = tm.get("serve.latency_s")
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert counters["serve.admitted"] == 4
+    assert counters["serve.evicted"] == 4
+    assert counters["serve.tokens_generated"] == 16
+    assert counters["serve.decode_steps"] == sched.decode_steps
+    assert gauges["serve.requests_in_flight"] == 0.0
+    assert ttft.get("count") == 4
+    assert latency.get("count") == 4
+
+
+# ---------------------------------------------------------------------------
+# encoder scoring (BERT serving path)
+# ---------------------------------------------------------------------------
+def test_encoder_scorer_parity_and_bucket_compiles(
+        _no_persistent_compile_cache):
+    with unique_name.guard():
+        paddle.seed(0)
+        model = BertForSequenceClassification(
+            BertConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                       num_heads=2, intermediate_size=64,
+                       max_position_embeddings=64, hidden_dropout=0.0,
+                       attention_dropout=0.0),
+            num_classes=3)
+    model.eval()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        scorer = model.scorer(max_batch=4, seq_buckets=(8, 16))
+        rng = np.random.RandomState(0)
+        seqs = [rng.randint(0, 128, n).tolist()
+                for n in (5, 8, 11, 16, 3, 7)]
+        got = scorer.score(seqs)
+        counts = telemetry.get_telemetry().compile_counts()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    assert got.shape == (6, 3)
+    assert counts.get("serve_score") == 2, counts  # one per bucket
+    for s, row in zip(seqs, got):
+        want = np.asarray(model(Tensor(np.asarray(s, np.int64)[None]))
+                          ._value)[0]
+        np.testing.assert_allclose(row, want, rtol=1e-4, atol=1e-5)

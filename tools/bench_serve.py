@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Serving benchmark: continuous batching vs batch-of-1 sequential decode.
+
+Serves a GPT config from ``paddle_tpu.models`` through the
+``paddle_tpu.serving`` tier (static-shape KV cache, prefill/decode split,
+slot-based continuous batching) and reports:
+
+* aggregate tokens/sec for (a) SEQUENTIAL serving — one request at a
+  time through a batch-1 engine, the no-batching baseline — and (b)
+  CONTINUOUS batching at ``--concurrency`` slots, plus the speedup;
+* user-perceived p50/p95 request latency (arrival → last token, so the
+  sequential baseline pays its queue wait — that is the point);
+* decode-batch occupancy and requests-in-flight from telemetry;
+* the O(1)-decode proof: telemetry compile counters (decode must compile
+  EXACTLY once; prefill once per length bucket) and a static graph-lint
+  of the decode step at two consecutive positions (zero shape-churn /
+  kv-cache findings).
+
+Emits one JSON line and (with ``--artifact``) a SERVE_r*.json. ``--smoke``
+runs a tiny CPU config and hard-asserts the telemetry contract — wired
+into ``tools/run_tests.sh`` as a CI gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pctl(xs, q):
+    return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
+
+
+def build_model(smoke):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if smoke:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=2, max_position_embeddings=64,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+    else:
+        # GPT-2 small (124M) — the same flagship config bench.py trains
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        hidden_dropout=0.0, attention_dropout=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return cfg, model
+
+
+def make_requests(cfg, n, max_new, buckets, seed):
+    from paddle_tpu.serving import Request
+
+    rng = np.random.RandomState(seed)
+    lo, hi = 4, max(5, buckets[-1] // 2)
+    return [Request(prompt=rng.randint(0, cfg.vocab_size,
+                                       int(rng.randint(lo, hi))).tolist(),
+                    max_new_tokens=max_new)
+            for _ in range(n)]
+
+
+def run_sequential(model, requests, max_len, buckets):
+    """Batch-of-1 serial decode: every request waits for its predecessors
+    (user-perceived latency includes that wait — all requests 'arrive' at
+    t0). Run OUTSIDE the telemetry window so the continuous engine's
+    compile counters stay clean (both steps share their step names)."""
+    from paddle_tpu.serving import GenerationEngine
+
+    eng = GenerationEngine(model, max_batch=1, max_len=max_len,
+                           prefill_buckets=buckets)
+    # warm every executable (one per bucket + decode) outside the timer
+    for b in buckets:
+        eng.generate([1] * min(b, max_len - 2), max_new_tokens=2)
+    t0 = time.perf_counter()
+    lat, tokens = [], 0
+    for req in requests:
+        out = eng.generate(req.prompt, max_new_tokens=req.max_new_tokens,
+                           eos_id=req.eos_id)
+        tokens += len(out)
+        lat.append(time.perf_counter() - t0)  # includes queue wait
+    wall = time.perf_counter() - t0
+    return {
+        "wall_s": round(wall, 4),
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2) if wall else None,
+        "p50_latency_s": round(_pctl(lat, 50), 4),
+        "p95_latency_s": round(_pctl(lat, 95), 4),
+    }
+
+
+def run_continuous(model, requests, max_len, buckets, concurrency):
+    """Continuous batching under telemetry: compiles (during warmup) and
+    the scheduler's serve.* stats all land in the registry."""
+    from paddle_tpu.profiler import telemetry
+    from paddle_tpu.serving import GenerationEngine, Scheduler
+
+    telemetry.reset()
+    # recompiling once per prefill bucket is the DESIGN here, not churn —
+    # lift the per-step-name warning threshold above the bucket count
+    telemetry.enable(recompile_warn_threshold=len(buckets) + 2)
+    eng = GenerationEngine(model, max_batch=concurrency, max_len=max_len,
+                           prefill_buckets=buckets)
+    for b in buckets:  # warm outside the timer; compiles are still counted
+        eng.prefill(0, [1] * min(b, max_len - 2))
+    eng.decode_once(np.zeros(concurrency, np.int32))
+
+    sched = Scheduler(eng)
+    t0 = time.perf_counter()
+    submit_ns = time.perf_counter_ns()
+    for req in requests:
+        sched.submit(req)
+        req.submit_ns = submit_ns  # common arrival instant, like sequential
+    finished = sched.run()
+    wall = time.perf_counter() - t0
+
+    lat = [r.latency_s for r in finished if r.latency_s is not None]
+    ttft = [r.ttft_s for r in finished if r.ttft_s is not None]
+    tokens = sum(len(r.tokens) for r in finished)
+    tm = telemetry.get_telemetry()
+    stats = {
+        "wall_s": round(wall, 4),
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2) if wall else None,
+        "p50_latency_s": round(_pctl(lat, 50), 4),
+        "p95_latency_s": round(_pctl(lat, 95), 4),
+        "p50_ttft_s": round(_pctl(ttft, 50), 4),
+        "p95_ttft_s": round(_pctl(ttft, 95), 4),
+        "batch_occupancy": round(sched.occupancy(), 4),
+        "decode_steps": sched.decode_steps,
+        "requests_in_flight": tm.gauges().get("serve.requests_in_flight"),
+    }
+    # publish the bench headline back into the registry so the telemetry
+    # block (and anything tailing the exporter) carries it
+    tm.set_gauge("serve.tokens_per_s", stats["tokens_per_sec"] or 0.0)
+    tm.set_gauge("serve.p95_latency_s", stats["p95_latency_s"])
+    tm.set_gauge("serve.p50_latency_s", stats["p50_latency_s"])
+    tm.set_gauge("serve.batch_occupancy", stats["batch_occupancy"])
+    telemetry.disable()  # data stays readable for the block below
+    return eng, sched, stats
+
+
+def lint_decode(eng):
+    """Static O(1) proof: lint the decode step against two CONSECUTIVE
+    positions — with the static cache both signatures are identical, so
+    shape-churn/kv-cache findings must be zero."""
+    from paddle_tpu import analysis
+
+    a1 = eng.example_decode_args([5] * min(2, eng.max_batch))
+    a2 = eng.example_decode_args([6] * min(2, eng.max_batch))
+    report = analysis.lint_step(eng.decode_step, *a1, extra_args=[a2])
+    churn = [f for f in report
+             if f.rule in ("retrace-shape-churn", "kv-cache-concat")]
+    return {
+        "findings": len(report),
+        "shape_churn_findings": len(churn),
+        "rules": sorted({f.rule for f in report}),
+    }
+
+
+def telemetry_serve_block():
+    from paddle_tpu.profiler import telemetry
+
+    s = telemetry.summary()
+    block = {k: v for k, v in s["gauges"].items() if k.startswith("serve.")}
+    block.update({k: v for k, v in s["counters"].items()
+                  if k.startswith("serve.")})
+    block["compiles"] = dict(s["compiles"])
+    block["recompile_count"] = int(s["recompile_count"])
+    for name in ("serve.ttft_s", "serve.tpot_s", "serve.latency_s"):
+        st = telemetry.get_telemetry().get(name)
+        if st and st.get("count"):
+            block[name + ".mean"] = round(st["sum"] / st["count"], 6)
+    return block
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU config + hard telemetry assertions "
+                         "(the run_tests.sh CI gate)")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--max-new-tokens", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--artifact", default=None)
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.concurrency = min(args.concurrency, 4)
+    n_req = args.requests or 2 * args.concurrency
+    max_new = args.max_new_tokens or (8 if args.smoke else 64)
+
+    cfg, model = build_model(args.smoke)
+    # size the cache to the workload: largest prompt (buckets[-1]/2) plus
+    # the generation budget — decode attention + cache traffic scale with
+    # max_len, so capacity beyond the worst case is pure per-step cost
+    max_len = 64 if args.smoke else 32 + max_new
+    buckets = (8, 16) if args.smoke else (16, 64)
+
+    requests = make_requests(cfg, n_req, max_new, buckets, args.seed)
+    # identical prompts for both runs (Request objects are stateful):
+    from paddle_tpu.serving import Request
+
+    seq_requests = [Request(prompt=list(r.prompt),
+                            max_new_tokens=r.max_new_tokens)
+                    for r in requests]
+
+    sequential = run_sequential(model, seq_requests, max_len, buckets)
+    eng, sched, continuous = run_continuous(model, requests, max_len,
+                                            buckets, args.concurrency)
+    lint = lint_decode(eng)
+    tblock = telemetry_serve_block()
+
+    speedup = None
+    if sequential["tokens_per_sec"] and continuous["tokens_per_sec"]:
+        speedup = round(continuous["tokens_per_sec"]
+                        / sequential["tokens_per_sec"], 3)
+
+    result = {
+        "metric": "serve_tokens_per_sec",
+        "value": continuous["tokens_per_sec"],
+        "unit": "tok/s",
+        "speedup_vs_sequential": speedup,
+        "config": {
+            "model": "gpt2-smoke" if args.smoke else "gpt2-124M",
+            "vocab_size": cfg.vocab_size, "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers, "num_heads": cfg.num_heads,
+            "max_len": max_len, "prefill_buckets": list(buckets),
+            "concurrency": args.concurrency, "requests": n_req,
+            "max_new_tokens": max_new,
+        },
+        "sequential": sequential,
+        "continuous": continuous,
+        "decode_lint": lint,
+        "telemetry": tblock,
+    }
+    print(json.dumps(result))
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+
+    # CI contract (the satellite gate): the telemetry block must carry the
+    # serving headline gauges, the decode step must have compiled exactly
+    # once, and the static lint must see a shape-stable decode
+    problems = []
+    if "serve.tokens_per_s" not in tblock:
+        problems.append("telemetry block missing serve.tokens_per_s")
+    if "serve.p95_latency_s" not in tblock:
+        problems.append("telemetry block missing serve.p95_latency_s")
+    if tblock["compiles"].get("serve_decode") != 1:
+        problems.append(f"decode compiled "
+                        f"{tblock['compiles'].get('serve_decode')}x "
+                        f"(want exactly 1)")
+    if tblock["compiles"].get("serve_prefill", 0) > len(buckets):
+        problems.append("prefill compiled more than once per bucket")
+    if lint["shape_churn_findings"]:
+        problems.append(f"decode lint: {lint['shape_churn_findings']} "
+                        f"shape-churn/kv-cache finding(s)")
+    if problems:
+        print("bench_serve FAILED: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,7 +142,30 @@ def build_bert(fixture=None):
         lambda r: r.randint(0, 512, (4, 64)).astype(np.int32))
 
 
-ZOO = {"mlp": build_mlp, "resnet": build_resnet, "bert": build_bert}
+def build_serve_decode(fixture=None):
+    """The serving tier's batched decode step (tiny GPT, static-shape KV
+    cache) against two CONSECUTIVE generation positions — the O(1)-decode
+    acceptance gate: with the preallocated cache both example batches have
+    IDENTICAL signatures, so the `retrace-shape-churn` and
+    `kv-cache-concat` rules must stay silent (the grow-by-concat cache
+    they exist to catch is regression-tested in tests/test_serving.py)."""
+    del fixture  # no optimizer in the serving path
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    engine = GenerationEngine(GPTForCausalLM(cfg), max_batch=2, max_len=32,
+                              prefill_buckets=(8,))
+    return engine.decode_step, [engine.example_decode_args([5, 3]),
+                                engine.example_decode_args([6, 4])]
+
+
+ZOO = {"mlp": build_mlp, "resnet": build_resnet, "bert": build_bert,
+       "serve-decode": build_serve_decode}
 
 
 def lint_zoo(models, fixture=None, run_steps=0, out=sys.stdout):

@@ -121,11 +121,19 @@ with LogWriter(sys.argv[1], file_name="devprof_smoke.jsonl") as w:
 PYEOF
   python tools/mem_report.py "$SMOKE_DIR/devprof_smoke.jsonl"
   # graph-lint gate: statically lint the bench-zoo train steps (resnet +
-  # bert, no device execution) — any error-severity finding (e.g. a
-  # state-pytree retrace hazard like the Adam lazy-accumulator
-  # double-trace) fails the runner via its exit status
-  JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert \
+  # bert, no device execution) plus the serving tier's batched decode
+  # step — any error-severity finding (a state-pytree retrace hazard, or
+  # a kv-cache-concat/shape-churn finding on the decode step, which must
+  # be shape-stable across positions) fails the runner via exit status
+  JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert serve-decode \
     --jsonl "$SMOKE_DIR/graph_lint.jsonl"
+  # serving smoke (tiny gpt, CPU): continuous batching vs sequential
+  # decode through the static KV cache; bench_serve --smoke hard-asserts
+  # the telemetry contract — serve.tokens_per_s / serve.p95_latency_s
+  # present, decode compiled EXACTLY once, prefill <= once per length
+  # bucket, zero shape-churn/kv-cache lint findings on the decode step
+  JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke \
+    --artifact "$SMOKE_DIR/serve_smoke.json"
   # checkpoint-doctor smoke: write two CheckpointManager steps (one torn
   # via fault injection), then exercise the verify/inspect/prune CLI —
   # verify MUST flag the torn step (exit 1) and pass the intact one
