@@ -21,20 +21,29 @@ from __future__ import annotations
 
 import warnings
 
-from .findings import SEVERITIES, Finding, LintReport  # noqa: F401
+from .findings import SEVERITIES, Finding, LintReport, sarif_report  # noqa: F401,E501
 from .graph_lint import (  # noqa: F401
     LINT_DEFAULTS,
     StepGraph,
     lint_step,
     trace_step,
 )
-from .crosscheck import RETRACE_RULES, crosscheck_telemetry  # noqa: F401
+from .crosscheck import (  # noqa: F401
+    COMM_RTOL,
+    RETRACE_RULES,
+    crosscheck_comm,
+    crosscheck_telemetry,
+)
 from .rules import RULES, register_rule, rule_ids  # noqa: F401
+from . import shard_lint  # noqa: F401
+from .shard_lint import ShardingAnalysis, analyze_sharding  # noqa: F401
 
 __all__ = [
     "SEVERITIES", "Finding", "LintReport", "StepGraph", "LINT_DEFAULTS",
     "lint_step", "trace_step", "crosscheck_telemetry", "RETRACE_RULES",
+    "crosscheck_comm", "COMM_RTOL", "sarif_report",
     "RULES", "register_rule", "rule_ids",
+    "shard_lint", "ShardingAnalysis", "analyze_sharding",
     "enable_lint_on_compile", "lint_on_compile_enabled", "autolint",
 ]
 
@@ -53,7 +62,8 @@ def lint_on_compile_enabled():
     return _ON_COMPILE
 
 
-def autolint(step, args=(), kwargs=None, enabled=None, ignore=()):
+def autolint(step, args=(), kwargs=None, enabled=None, ignore=(),
+             mesh=None, in_shardings=None):
     """One-shot lint used by the framework integration points
     (``CompiledStep.__call__`` on first compile, ``hapi.Model``/auto_parallel
     ``Engine`` at first fit). Never raises — a lint bug must not take down a
@@ -73,8 +83,8 @@ def autolint(step, args=(), kwargs=None, enabled=None, ignore=()):
     except Exception:
         pass
     try:
-        report = lint_step(step, *tuple(args), ignore=ignore,
-                           **(kwargs or {}))
+        report = lint_step(step, *tuple(args), ignore=ignore, mesh=mesh,
+                           in_shardings=in_shardings, **(kwargs or {}))
     except Exception as e:  # noqa: BLE001 - advisory pass only
         warnings.warn(f"graph lint failed on "
                       f"'{getattr(step, 'name', step)}': {e!r}",

@@ -5,10 +5,23 @@ once". PR 2's telemetry counts what actually happened
 (``Telemetry.compile_counts``). This module joins the two so the analysis
 pass can be validated against reality — a lint that cries retrace on a step
 the runtime compiled exactly once is a lint bug, and vice versa.
+
+ISSUE 7 extends the same accuracy loop to communication:
+:func:`crosscheck_comm` joins shard-lint's *predicted* per-axis collective
+bytes (:mod:`.shard_lint`, abstract propagation — no compile) against
+devprof's HLO-*measured* ``comm.bytes.<axis>`` counters (PR 5, compiled
+ground truth). A predicted axis that the compiled program never touches —
+or measured traffic the propagation missed — is a shard-lint bug surfaced
+as ``agrees=False``.
 """
 from __future__ import annotations
 
-__all__ = ["RETRACE_RULES", "crosscheck_telemetry"]
+__all__ = ["RETRACE_RULES", "crosscheck_telemetry", "crosscheck_comm",
+           "COMM_RTOL"]
+
+#: default relative tolerance for predicted-vs-measured collective bytes
+#: (explicit shard_map collectives are exact; GSPMD propagation is a model)
+COMM_RTOL = 0.10
 
 #: rules whose findings predict >1 compilation of the step
 RETRACE_RULES = frozenset({
@@ -60,3 +73,69 @@ def crosscheck_telemetry(report, telemetry_summary=None):
             "agrees": ((observed > 1) == predicted) if observed else None,
         })
     return out
+
+
+def _bytes_by_axis(obj):
+    """Coerce any of the comm-carrying shapes into ``{axis: bytes}``:
+    a ``ShardingAnalysis``, a ``DeviceCostReport``, a ``CollectiveStats``,
+    a plain dict, or ``None`` (→ pull the ``comm.bytes.<axis>`` counters
+    from the process telemetry registry)."""
+    if obj is None:
+        from ..profiler import telemetry
+
+        counters = telemetry.get_telemetry().counters()
+        return {k[len("comm.bytes."):]: float(v)
+                for k, v in counters.items()
+                if k.startswith("comm.bytes.")}
+    for attr in ("bytes_by_axis",):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            return {str(a): float(b) for a, b in fn().items()}
+    coll = getattr(obj, "collectives", obj)
+    by_axis = getattr(coll, "by_axis", None)
+    if by_axis is not None:
+        return {str(a): float(st["bytes"]) for a, st in by_axis.items()}
+    if isinstance(obj, dict):
+        return {str(a): float(b) for a, b in obj.items()}
+    raise TypeError(f"cannot read per-axis comm bytes from {type(obj)!r}")
+
+
+def crosscheck_comm(predicted, measured=None, rtol=COMM_RTOL):
+    """Join shard-lint *predicted* per-axis collective bytes with devprof's
+    HLO-*measured* ones.
+
+    Args:
+        predicted: a ``shard_lint.ShardingAnalysis`` (or anything exposing
+            per-axis bytes — see :func:`_bytes_by_axis`).
+        measured: a ``devprof.DeviceCostReport`` / ``CollectiveStats`` /
+            ``{axis: bytes}`` dict; ``None`` pulls the accumulated
+            ``comm.bytes.<axis>`` telemetry counters (what
+            ``DeviceCostReport.register`` published).
+        rtol: relative tolerance for ``agrees`` (default ``COMM_RTOL``).
+
+    Returns:
+        One row per mesh axis seen on either side::
+
+            {"axis": str, "predicted_bytes": float, "measured_bytes": float,
+             "ratio": float|None,   # predicted / measured (None when 0/0)
+             "agrees": bool}        # within rtol (an axis only one side
+                                    #  saw never agrees)
+    """
+    pred = _bytes_by_axis(predicted)
+    meas = _bytes_by_axis(measured)
+    rows = []
+    for axis in sorted(set(pred) | set(meas)):
+        p = float(pred.get(axis, 0.0))
+        m = float(meas.get(axis, 0.0))
+        if m > 0:
+            ratio = p / m
+            agrees = abs(p - m) <= rtol * m
+        elif p > 0:
+            ratio = None
+            agrees = False
+        else:
+            ratio = None
+            agrees = True
+        rows.append({"axis": axis, "predicted_bytes": p,
+                     "measured_bytes": m, "ratio": ratio, "agrees": agrees})
+    return rows

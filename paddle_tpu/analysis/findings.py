@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import json
 
-__all__ = ["SEVERITIES", "Finding", "LintReport"]
+__all__ = ["SEVERITIES", "Finding", "LintReport", "sarif_report"]
 
 #: severity levels in ascending order
 SEVERITIES = ("info", "warning", "error")
@@ -40,6 +40,10 @@ class Finding:
             finding anchors to a traced operation, else "".
         hint: the suggested fix, copy-pasteable where possible.
         data: rule-specific structured payload (shapes, byte counts, …).
+        extra: unknown top-level keys seen by :meth:`from_dict` — preserved
+            verbatim so JSONL written by a newer writer (or with side-band
+            keys like the CLI's ``model``) reloads losslessly instead of
+            silently dropping fields.
     """
 
     rule: str
@@ -50,14 +54,22 @@ class Finding:
     where: str = ""
     hint: str = ""
     data: dict = dataclasses.field(default_factory=dict)
+    extra: dict = dataclasses.field(default_factory=dict, repr=False)
 
     def as_dict(self):
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        extra = d.pop("extra")
+        # round-trip contract: from_dict(as_dict(f)) == f AND
+        # as_dict(from_dict(d)) == d for dicts carrying unknown keys —
+        # known fields always win a name collision
+        return {**{k: v for k, v in extra.items() if k not in d}, **d}
 
     @classmethod
     def from_dict(cls, d):
-        known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in d.items() if k in known})
+        known = {f.name for f in dataclasses.fields(cls)} - {"extra"}
+        kw = {k: v for k, v in d.items() if k in known}
+        kw["extra"] = {k: v for k, v in d.items() if k not in known}
+        return cls(**kw)
 
     def __str__(self):
         loc = self.path or self.where
@@ -127,6 +139,11 @@ class LintReport:
                 findings.append(Finding.from_dict(json.loads(line)))
         return cls(findings)
 
+    def to_sarif(self, tool="paddle-tpu-graph-lint"):
+        """This report as a SARIF 2.1.0 document (see
+        :func:`sarif_report`)."""
+        return sarif_report(self.findings, tool=tool)
+
     def table(self):
         """Render the findings as a fixed-width table (CLI / report uses)."""
         if not self.findings:
@@ -146,3 +163,71 @@ class LintReport:
         lines.append("totals: " + ", ".join(
             f"{counts.get(s, 0)} {s}" for s in reversed(SEVERITIES)))
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# SARIF export (CI annotations — ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+#: lint severity -> SARIF result level
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _sarif_location(f):
+    """``where``/``path`` provenance -> a SARIF physicalLocation (or None
+    when the finding has no file anchor — pytree-path findings get the
+    message only)."""
+    loc = f.where or ""
+    if ":" not in loc:
+        return None
+    uri, _, line = loc.rpartition(":")
+    try:
+        line = int(line)
+    except ValueError:
+        return None
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": uri},
+            "region": {"startLine": max(line, 1)},
+        }
+    }
+
+
+def sarif_report(findings, tool="paddle-tpu-graph-lint"):
+    """Render findings as a SARIF 2.1.0 document (dict — ``json.dump`` it)
+    so CI systems (GitHub code scanning et al.) surface lint findings as
+    inline annotations. One ``rule`` entry per distinct rule id; the
+    pytree path / step name ride in ``properties``."""
+    findings = list(findings)
+    rule_ids = []
+    for f in findings:
+        if f.rule not in rule_ids:
+            rule_ids.append(f.rule)
+    results = []
+    for f in findings:
+        msg = f.message + (f" — {f.hint}" if f.hint else "")
+        res = {
+            "ruleId": f.rule,
+            "level": _SARIF_LEVELS.get(f.severity, "note"),
+            "message": {"text": msg},
+            "properties": {k: v for k, v in
+                           (("step", f.step), ("path", f.path))
+                           if v},
+        }
+        loc = _sarif_location(f)
+        if loc is not None:
+            res["locations"] = [loc]
+        results.append(res)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool,
+                "informationUri": "https://github.com/PaddlePaddle/Paddle",
+                "rules": [{"id": r} for r in rule_ids],
+            }},
+            "results": results,
+        }],
+    }

@@ -15,12 +15,14 @@ runs (cross-checked in :mod:`.crosscheck`).
 from __future__ import annotations
 
 import os
+import warnings
 
 import jax
 import numpy as np
 
 from .findings import LintReport
 from .rules import run_rules
+from .shard_lint import SHARD_LINT_DEFAULTS
 
 __all__ = ["StepGraph", "trace_step", "lint_step", "LINT_DEFAULTS"]
 
@@ -29,6 +31,7 @@ LINT_DEFAULTS = {
     "donate_min_bytes": 1 << 20,   # hbm-undonated-input size floor
     "const_warn_bytes": 1 << 20,   # hbm-const-folded warning floor
     "const_error_bytes": 64 << 20,  # …and the error escalation point
+    **SHARD_LINT_DEFAULTS,         # spmd-* rule thresholds (ISSUE 7)
 }
 
 
@@ -126,6 +129,9 @@ class StepGraph:
         self.donate_inputs = donate_inputs
         self.config = dict(LINT_DEFAULTS, **(config or {}))
         self.variants = []
+        # populated by lint_step when a mesh is in play: the abstract SPMD
+        # propagation (shard_lint.ShardingAnalysis) the spmd-* rules read
+        self.sharding = None
 
         def _paths(prefix, tree):
             return [(_path_str(prefix, p), l) for p, l in
@@ -211,7 +217,29 @@ def _env_ignore():
     return tuple(x.strip() for x in raw.split(",") if x.strip())
 
 
-def lint_step(step, *args, extra_args=(), ignore=(), config=None, **kwargs):
+#: unknown rule ids already warned about (once per process, not per lint)
+_WARNED_UNKNOWN_IGNORE = set()
+
+
+def _check_ignore(ignore, source):
+    """An ``ignore=`` entry naming a rule that doesn't exist is almost
+    always a typo silently un-silencing the real rule — warn once per
+    unknown id instead of no-opping."""
+    from .rules import RULES
+
+    for rule_id in ignore:
+        if rule_id in RULES or rule_id in _WARNED_UNKNOWN_IGNORE:
+            continue
+        _WARNED_UNKNOWN_IGNORE.add(rule_id)
+        warnings.warn(
+            f"graph lint: {source} names unknown rule id '{rule_id}' "
+            f"(known: {', '.join(sorted(RULES))})",
+            RuntimeWarning, stacklevel=3)
+    return tuple(ignore)
+
+
+def lint_step(step, *args, extra_args=(), ignore=(), config=None, mesh=None,
+              in_shardings=None, **kwargs):
     """Lint a step function against the example batch ``args``/``kwargs``.
 
     Args:
@@ -220,8 +248,16 @@ def lint_step(step, *args, extra_args=(), ignore=(), config=None, **kwargs):
             or ``(args, kwargs)`` tuples — enables the cross-batch
             ``retrace-shape-churn`` / ``retrace-static-value`` rules.
         ignore: rule ids to silence (merged with the comma-separated
-            ``PADDLE_TPU_LINT_IGNORE`` environment variable).
+            ``PADDLE_TPU_LINT_IGNORE`` environment variable; ids are
+            checked against the registry — unknown ids warn once).
         config: threshold overrides (see :data:`LINT_DEFAULTS`).
+        mesh: a :class:`jax.sharding.Mesh` to run the abstract SPMD
+            propagation under (:mod:`.shard_lint`), enabling the
+            ``spmd-*`` rules. When omitted, a mesh is inferred from the
+            example batch / state ``NamedSharding`` leaves, so multichip
+            steps get the sharding lint automatically.
+        in_shardings: optional ``{input path: PartitionSpec}`` overrides
+            for the propagation (defaults come from the leaves).
 
     Returns:
         :class:`~paddle_tpu.analysis.findings.LintReport`
@@ -234,5 +270,21 @@ def lint_step(step, *args, extra_args=(), ignore=(), config=None, **kwargs):
         else:
             vargs, vkwargs = tuple(extra), {}
         graph.add_variant(vargs, vkwargs)
-    ignore = tuple(ignore) + _env_ignore()
-    return LintReport(run_rules(graph, ignore=ignore), step=graph.name)
+    try:
+        from . import shard_lint
+
+        graph.sharding = shard_lint.analyze_sharding(
+            graph, mesh=mesh, in_shardings=in_shardings)
+    except Exception as e:  # noqa: BLE001 - the spmd pass is advisory
+        warnings.warn(f"shard lint propagation failed on '{graph.name}': "
+                      f"{e!r}", RuntimeWarning, stacklevel=2)
+        graph.sharding = None
+    # per-call ignore applies first; the env var adds on top (union) — a
+    # per-call list can therefore never un-silence an env-ignored rule
+    ignore = (_check_ignore(tuple(ignore), "ignore=")
+              + _check_ignore(_env_ignore(), "PADDLE_TPU_LINT_IGNORE"))
+    report = LintReport(run_rules(graph, ignore=ignore), step=graph.name)
+    # expose the propagation to callers (CLI tables, crosscheck_comm) —
+    # None when no mesh was in play
+    report.sharding = graph.sharding
+    return report

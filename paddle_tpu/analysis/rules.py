@@ -13,6 +13,11 @@ Rule families (ISSUE 3):
 * ``hbm-*``        — device-memory waste visible in the lowered program
 * ``tpu-*``        — ops the TPU executes poorly (hot-path gathers, opaque
                      custom calls XLA cannot fuse across)
+* ``spmd-*``       — (ISSUE 7) multichip sharding hazards predicted by the
+                     abstract SPMD propagation in :mod:`.shard_lint`; these
+                     run only when the step was linted under a mesh
+                     (``lint_step(..., mesh=...)`` or inferable from the
+                     example batch/state shardings)
 """
 from __future__ import annotations
 
@@ -452,6 +457,190 @@ def _custom_call(graph):
                 hint="fold pre/post elementwise math into the kernel itself "
                      "if the boundary buffers show up in the profile",
             )
+
+
+# ---------------------------------------------------------------------------
+# SPMD sharding hazards (shard_lint propagation — ISSUE 7)
+# ---------------------------------------------------------------------------
+def _sharding_of(graph):
+    return getattr(graph, "sharding", None)
+
+
+def _fmt_mib(n):
+    return f"{n / 2**20:.2f} MiB" if n >= 2**20 else f"{n / 1024:.1f} KiB"
+
+
+@register_rule(
+    "spmd-implicit-resharding", "error",
+    "propagated sharding disagrees with a downstream constraint/contraction:"
+    " GSPMD inserts an all-gather")
+def _spmd_implicit_resharding(graph):
+    """A value flows into a ``with_sharding_constraint`` (or a dot whose
+    contraction dims are sharded on *different* axes per operand) that its
+    propagated sharding cannot satisfy — the SPMD partitioner silently
+    inserts an all-gather/all-to-all every step. The finding carries the
+    axis, the predicted bytes/device/step, and a copy-pasteable constraint
+    hint. Input-valued conflicts are reported by the more specific
+    ``spmd-sharding-mismatch`` instead."""
+    sa = _sharding_of(graph)
+    if sa is None:
+        return
+    from .shard_lint import _spec_str
+
+    for r in sa.reshards:
+        if r.kind not in ("constraint", "dot") or r.path:
+            continue
+        axis = "+".join(r.axes)
+        what = ("the sharding constraint" if r.kind == "constraint"
+                else "a dot contraction sharded on a different axis")
+        yield Finding(
+            rule="spmd-implicit-resharding",
+            severity="error",
+            message=f"propagated sharding {_spec_str(r.from_spec)} "
+                    f"disagrees with {what}: GSPMD inserts an {r.op} over "
+                    f"mesh axis '{axis}' ({_fmt_mib(r.bytes)}/device/step)",
+            where=r.where,
+            hint=f"make the producer agree with the consumer — constrain "
+                 f"it at creation: with_sharding_constraint(value, "
+                 f"NamedSharding(mesh, {_spec_str(r.to_spec)})), or fix "
+                 f"the mismatched constraint to {_spec_str(r.from_spec)}",
+            data={"axis": axis, "bytes": r.bytes, "op": r.op,
+                  "kind": r.kind, "from_spec": _spec_str(r.from_spec),
+                  "to_spec": _spec_str(r.to_spec)},
+        )
+
+
+@register_rule(
+    "spmd-sharding-mismatch", "error",
+    "an input's staged sharding conflicts with its first use: silent full "
+    "reshard every step")
+def _spmd_sharding_mismatch(graph):
+    """The example batch/state arrives on the mesh with a sharding its very
+    first consumer cannot use — every step pays a full reshard before any
+    compute. Distinct from ``spmd-implicit-resharding``: the fix is at the
+    staging site (``DeviceLoader place_fn`` / ``device_put`` spec), not in
+    the step body."""
+    sa = _sharding_of(graph)
+    if sa is None:
+        return
+    from .shard_lint import _spec_str
+
+    seen = set()
+    for r in sa.reshards:
+        if not r.path or r.path in seen:
+            continue
+        seen.add(r.path)
+        axis = "+".join(r.axes)
+        yield Finding(
+            rule="spmd-sharding-mismatch",
+            severity="error",
+            message=f"input {r.path} is staged as "
+                    f"{_spec_str(r.from_spec)} but its first use needs "
+                    f"{_spec_str(r.to_spec)}: GSPMD reshards it "
+                    f"({r.op} over '{axis}', "
+                    f"{_fmt_mib(r.bytes)}/device/step)",
+            path=r.path,
+            where=r.where,
+            hint=f"stage it in the layout the step consumes: "
+                 f"jax.device_put(x, NamedSharding(mesh, "
+                 f"{_spec_str(r.to_spec)})) (DeviceLoader place_fn does "
+                 f"this off the hot path)",
+            data={"axis": axis, "bytes": r.bytes, "op": r.op,
+                  "from_spec": _spec_str(r.from_spec),
+                  "to_spec": _spec_str(r.to_spec)},
+        )
+
+
+@register_rule(
+    "spmd-replicated-optimizer-state", "warning",
+    "optimizer accumulators fully replicated across the data axis: the "
+    "ZeRO opportunity")
+def _spmd_replicated_optimizer_state(graph):
+    """Optimizer accumulator leaves (moments, master weights) replicated
+    across the data-parallel axis burn ``(dp-1)/dp`` of their HBM for
+    nothing — 'Automatic Cross-Replica Sharding of Weight Update in
+    Data-Parallel Training' (arxiv 2004.13336): reduce-scatter the grads,
+    shard the update, all-gather the params."""
+    sa = _sharding_of(graph)
+    if sa is None or sa.mesh is None:
+        return
+    sizes = sa.axis_order
+    data_axis = "dp" if "dp" in sizes else (next(iter(sizes), None))
+    if not data_axis or int(sizes.get(data_axis, 1)) <= 1:
+        return
+    threshold = graph.config.get("zero_min_bytes", 1 << 20)
+    repl_bytes = 0
+    example = ""
+    n_leaves = 0
+    for path, leaf in graph.state_in_paths:
+        if not path.startswith("state['optimizers']"):
+            continue
+        spec = sa.in_specs.get(path)
+        if spec is None:
+            continue
+        axes = {a for dim in spec for a in dim}
+        if data_axis in axes:
+            continue  # already ZeRO-sharded
+        nbytes = _nbytes(leaf)
+        denom = 1
+        for a in axes:
+            denom *= int(sizes.get(a, 1))
+        local = nbytes / max(denom, 1)
+        if local <= 0:
+            continue
+        repl_bytes += local
+        n_leaves += 1
+        if not example:
+            example = path
+    if repl_bytes < threshold:
+        return
+    dp = int(sizes[data_axis])
+    yield Finding(
+        rule="spmd-replicated-optimizer-state",
+        severity="warning",
+        message=f"{n_leaves} optimizer accumulator leaves "
+                f"({_fmt_mib(repl_bytes)}/device) are fully replicated "
+                f"across the '{data_axis}' axis (size {dp}): "
+                f"{_fmt_mib(repl_bytes * (dp - 1) / dp)}/device is "
+                f"redundant",
+        path=example,
+        hint="shard the weight update over the data axis (ZeRO): "
+             "distributed.sharding.group_sharded_parallel(model, opt, "
+             "level='os', group=...), or strategy.sharding=True with "
+             "sharding_configs['stage']=1 on the Engine",
+        data={"axis": data_axis, "bytes": repl_bytes,
+              "redundant_bytes": repl_bytes * (dp - 1) / dp,
+              "leaves": n_leaves},
+    )
+
+
+@register_rule(
+    "spmd-comm-bound-step", "warning",
+    "predicted interconnect traffic dominates the step's memory traffic")
+def _spmd_comm_bound(graph):
+    sa = _sharding_of(graph)
+    if sa is None or not sa.collectives:
+        return
+    threshold = graph.config.get("comm_bound_fraction", 0.25)
+    frac = sa.comm_fraction
+    if frac <= threshold:
+        return
+    per_axis = {a: st["bytes"] for a, st in sa.collectives.by_axis.items()}
+    worst = max(per_axis, key=per_axis.get)
+    yield Finding(
+        rule="spmd-comm-bound-step",
+        severity="warning",
+        message=f"predicted comm_fraction {frac:.2f} exceeds "
+                f"{threshold:.2f}: "
+                f"{_fmt_mib(sa.comm_bytes)}/device/step crosses the "
+                f"interconnect (axis '{worst}' moves the most)",
+        hint="grow the per-device work (bigger microbatch / longer "
+             "sequence), or re-balance the mesh away from the "
+             f"'{worst}' axis — compare candidates with "
+             "tools/shard_lint.py before burning a multichip run",
+        data={"comm_fraction": frac, "comm_bytes": sa.comm_bytes,
+              "bytes_by_axis": per_axis},
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,8 @@ class Engine:
                                    and get_current_process_mesh() is None
                                    and n_avail > 1)
         self.plan_ = None
+        # one-shot lint report (graph lint + shard lint) from the first fit
+        self.lint_report_ = None
 
     # -- auto planning -------------------------------------------------------
     def _auto_plan(self, x, y):
@@ -116,6 +118,7 @@ class Engine:
             stats["layers"] = 1  # generic models: no auto-pipelining
             planner = Planner(n, stats, exclusive_data_axis=True)
             plan = planner.plan()
+            plan = self._break_plan_tie(planner, plan, fwd_loss, x, y)
 
             data_ways = plan.dp * plan.sharding
             self._pm = ProcessMesh(np.arange(n).reshape(data_ways, plan.mp),
@@ -143,6 +146,94 @@ class Engine:
                 b._value = v
             if was_training:
                 model.train()
+
+    #: candidates whose analytic est_step_time is within this of the best
+    #: are indistinguishable to the alpha-beta model — shard-lint breaks
+    #: the tie with comm bytes predicted on the model's REAL forward jaxpr
+    PLAN_TIE_RTOL = 0.05
+
+    def _break_plan_tie(self, planner, best, fwd_loss, x, y):
+        """Re-rank near-tied planner candidates by predicted communication.
+
+        The planner's closed-form estimate can't separate placements whose
+        alpha-beta costs land within noise of each other (classic case:
+        mp vs ZeRO splits of the same device count). Shard-lint's abstract
+        propagation prices the collectives GSPMD would actually insert for
+        each candidate's placements over the forward jaxpr — no compile,
+        host-only — and the cheapest-communication candidate wins. Any
+        failure keeps the planner's original choice."""
+        try:
+            ties = [p for p in planner.enumerate_plans()
+                    if p.feasible and p.est_step_time
+                    <= best.est_step_time * (1.0 + self.PLAN_TIE_RTOL)]
+            if len(ties) <= 1:
+                return best
+            import jax as _jax
+
+            from ...framework import random as _rnd
+
+            # the model runs in eval() here (see _auto_plan), but restore
+            # the global RNG regardless — a key drawn inside make_jaxpr
+            # would otherwise leak out as a tracer
+            rng_state = _rnd.default_generator.get_state()
+            try:
+                closed = _jax.make_jaxpr(fwd_loss)(
+                    np.asarray(x._value), np.asarray(y._value))
+            finally:
+                _rnd.default_generator.set_state(rng_state)
+            id2name = {id(p._value): name
+                       for name, p in self.model.named_parameters()}
+            const_names = [id2name.get(id(c)) for c in closed.consts]
+            named_shapes = [(name, tuple(int(d) for d in p.shape))
+                            for name, p in self.model.named_parameters()]
+            for p in ties:
+                p.predicted_comm_bytes = self._plan_comm_bytes(
+                    closed, const_names, named_shapes, planner, p)
+            ties.sort(key=lambda p: (p.predicted_comm_bytes,
+                                     p.est_step_time))
+            return ties[0]
+        except Exception:  # noqa: BLE001 - ranking is best-effort
+            return best
+
+    def _plan_comm_bytes(self, closed, const_names, named_shapes, planner,
+                         plan):
+        """Predicted per-step interconnect bytes/device for one candidate:
+        shard-lint propagation over the forward jaxpr (≈ appears 3x per
+        train step: fwd + the two backward matmuls per dot) plus the ring
+        all-reduce/reduce-scatter of the parameter gradients the applied
+        dp/sharding degrees imply."""
+        from ...analysis import shard_lint
+
+        data_ways = max(plan.dp * plan.sharding, 1)
+        sizes = {"dp": data_ways, "mp": plan.mp}
+        placements = (planner.param_placements(named_shapes, plan)
+                      if plan.mp > 1 else {})
+        const_specs = []
+        for name, c in zip(const_names, closed.consts):
+            nd = len(tuple(getattr(c, "shape", ())))
+            spec = placements.get(name) if name else None
+            if spec and any(s is not None for s in spec):
+                const_specs.append(shard_lint._coerce_spec(spec, nd))
+            else:
+                const_specs.append(tuple(() for _ in range(nd)))
+        in_specs = []
+        for v in closed.jaxpr.invars:
+            shape = tuple(getattr(v.aval, "shape", ()))
+            sp = [()] * len(shape)
+            if (shape and data_ways > 1
+                    and int(shape[0]) % data_ways == 0):
+                sp[0] = ("dp",)
+            in_specs.append(tuple(sp))
+        sa = shard_lint.propagate_jaxpr(closed, in_specs, sizes,
+                                        const_specs=const_specs)
+        comm = 3.0 * sa.comm_bytes
+        pbytes = sum(4.0 * float(np.prod(s) if s else 1)
+                     for _, s in named_shapes) / max(plan.mp, 1)
+        if plan.dp > 1:
+            comm += 2.0 * (plan.dp - 1) / plan.dp * pbytes
+        if plan.sharding > 1:
+            comm += 3.0 * (plan.sharding - 1) / plan.sharding * pbytes
+        return comm
 
     # -- strategy ------------------------------------------------------------
     def _apply_strategy(self):
@@ -349,9 +440,28 @@ class Engine:
                         # deliberately disabled it (forced-host CPU mesh)
                         ignore = (("hbm-undonated-input",)
                                   if not step.donate_inputs else ())
-                        analysis.autolint(step, (first[0], first[1]),
-                                          enabled=self._graph_lint,
-                                          ignore=ignore)
+                        # a multi-device mesh additionally runs the shard
+                        # lint (abstract SPMD propagation -> spmd-* rules:
+                        # implicit resharding, replicated optimizer state,
+                        # comm-bound prediction) before the first dispatch.
+                        # The lint sees the RAW host batch, so hand it the
+                        # placement _place_array will apply (batch dim over
+                        # the data axis) as abstract spec overrides
+                        mesh = self._pm.jax_mesh
+                        in_shardings = None
+                        if mesh.size > 1:
+                            dname = self._pm.dim_names[0]
+                            dp = mesh.shape[dname]
+                            in_shardings = {}
+                            for i, t in enumerate((first[0], first[1])):
+                                shape = tuple(t.shape)
+                                if shape and shape[0] % dp == 0:
+                                    in_shardings[f"args[{i}]"] = (dname,)
+                        self.lint_report_ = analysis.autolint(
+                            step, (first[0], first[1]),
+                            enabled=self._graph_lint, ignore=ignore,
+                            mesh=mesh if mesh.size > 1 else None,
+                            in_shardings=in_shardings)
                     it = itertools.chain([first], it)
                 skip = start_step if (sess is not None
                                       and epoch == start_epoch) else 0

@@ -58,6 +58,10 @@ class Plan:
     est_device_bytes: float = 0.0
     feasible: bool = True
     placements: dict = field(default_factory=dict)
+    # shard-lint predicted interconnect bytes/device/step for this plan's
+    # placements (filled by Engine._break_plan_tie when candidates tie on
+    # the analytic estimate; 0.0 = not ranked)
+    predicted_comm_bytes: float = 0.0
 
     @property
     def degrees(self):

@@ -222,7 +222,7 @@ _HLO_OP_RE = re.compile(
     r"(-start)?\(")
 _HLO_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _HLO_GROUPS_RE = re.compile(
-    r"(?:replica_groups|source_target_pairs)=(\{[^=]*?\}|\[[0-9,]+\]"
+    r"(?:replica_groups|source_target_pairs)=(\{\}|\{\{.*?\}\}|\[[0-9,]+\]"
     r"<=\[[0-9,]+\](?:T\([0-9,]+\))?)")
 
 

@@ -317,6 +317,24 @@ def test_hlo_group_decoding():
     assert devprof._decode_groups("[2,2]<=[2,2]T(1,0)") == [[0, 2], [1, 3]]
 
 
+def test_hlo_explicit_brace_groups_attributed_per_axis():
+    """Regression (found by the ISSUE 7 shard-lint crosscheck): the line
+    regex used to truncate `{{0,1},{2,3}}` at the FIRST closing brace, so
+    explicit-brace groups decoded to None = "all devices" — mislabeling a
+    2-wide mp all-reduce as dp+mp and mispricing it with S=4."""
+    mesh = build_mesh({"dp": 2, "mp": 2})
+    line = ("%all-reduce = f32[8,32]{1,0} all-reduce(f32[8,32]{1,0} "
+            "%dot.1), channel_id=1, replica_groups={{0,1},{2,3}}, "
+            "use_global_device_ids=true, to_apply=%add.clone")
+    st = devprof.collectives_from_hlo(line, mesh=mesh)
+    # groups {0,1}/{2,3} vary the mp coordinate only; S=2 ⇒ factor 1
+    assert st.as_dict() == {"mp": {"count": 1, "bytes": 8 * 32 * 4.0,
+                                   "prims": {"all-reduce": 1}}}
+    line_dp = line.replace("{{0,1},{2,3}}", "{{0,2},{1,3}}")
+    st2 = devprof.collectives_from_hlo(line_dp, mesh=mesh)
+    assert list(st2.as_dict()) == ["dp"]
+
+
 # ---------------------------------------------------------------------------
 # pipeline bubble + straggler metrics
 # ---------------------------------------------------------------------------

@@ -468,7 +468,10 @@ def test_cli_jsonl_round_trip(tmp_path, capsys):
     for d in lines:
         assert d["model"] == "mlp"
         f = analysis.Finding.from_dict(d)
-        assert f.as_dict() == {k: v for k, v in d.items() if k != "model"}
+        # forward-compatible round trip: unknown top-level keys (the CLI's
+        # `model` side-band here) are preserved, not dropped
+        assert f.extra == {"model": "mlp"}
+        assert f.as_dict() == d
     table = capsys.readouterr().out
     assert "mlp_train_step" in table and "graph lint:" in table
 

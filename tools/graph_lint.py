@@ -206,6 +206,9 @@ def main(argv=None):
                     choices=sorted(ZOO))
     ap.add_argument("--jsonl", default=None,
                     help="write one JSON object per finding to this path")
+    ap.add_argument("--format", default="table", choices=["table", "sarif"],
+                    help="sarif: emit a SARIF 2.1.0 document on stdout "
+                         "(CI annotations) instead of tables")
     ap.add_argument("--fixture", default=None, choices=["adam-lazy"],
                     help="adam-lazy: pre-fix lazy-accumulator optimizer")
     ap.add_argument("--fail-on", default="error",
@@ -216,8 +219,17 @@ def main(argv=None):
                          "and print the lint-vs-telemetry crosscheck")
     args = ap.parse_args(argv)
 
+    sink = open(os.devnull, "w") if args.format == "sarif" else sys.stdout
     results = lint_zoo(args.models, fixture=args.fixture,
-                       run_steps=args.run_steps)
+                       run_steps=args.run_steps, out=sink)
+
+    if args.format == "sarif":
+        from paddle_tpu.analysis import sarif_report
+
+        findings = [f for _, report in results for f in report]
+        json.dump(sarif_report(findings, tool="paddle-tpu-graph-lint"),
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
 
     if args.jsonl:
         with open(args.jsonl, "w") as fh:
@@ -226,12 +238,12 @@ def main(argv=None):
                     fh.write(json.dumps({"model": name, **f.as_dict()},
                                         sort_keys=True) + "\n")
         print(f"\nwrote {sum(len(r) for _, r in results)} findings to "
-              f"{args.jsonl}")
+              f"{args.jsonl}", file=sink)
 
     n_err = sum(len(r.errors) for _, r in results)
     n_warn = sum(len(r.warnings) for _, r in results)
     print(f"\ngraph lint: {n_err} error(s), {n_warn} warning(s) across "
-          f"{len(results)} model(s)")
+          f"{len(results)} model(s)", file=sink)
     if args.fail_on == "never":
         return 0
     gate = n_err + (n_warn if args.fail_on == "warning" else 0)

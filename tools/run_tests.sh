@@ -127,6 +127,17 @@ PYEOF
   # be shape-stable across positions) fails the runner via exit status
   JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert serve-decode \
     --jsonl "$SMOKE_DIR/graph_lint.jsonl"
+  # shard-lint gate (ISSUE 7): abstract SPMD propagation over the MULTICHIP
+  # zoo — the dp×mp + MoE configs must lint with zero error findings AND
+  # the predicted per-axis collective bytes must agree with the
+  # compiled-HLO measurement (--measure; exit 1 on either), while the
+  # injected mismatched-constraint fixture MUST be flagged (exit 1)
+  JAX_PLATFORMS=cpu python tools/shard_lint.py --models dp-mp moe --measure \
+    --jsonl "$SMOKE_DIR/shard_lint.jsonl"
+  if JAX_PLATFORMS=cpu python tools/shard_lint.py --models dp-mp \
+      --fixture mismatched-constraint > /dev/null 2>&1; then
+    echo "shard_lint missed the mismatched-constraint fixture" >&2; exit 1
+  fi
   # serving smoke (tiny gpt, CPU): continuous batching vs sequential
   # decode through the static KV cache; bench_serve --smoke hard-asserts
   # the telemetry contract — serve.tokens_per_s / serve.p95_latency_s

@@ -1,0 +1,414 @@
+#!/usr/bin/env python
+"""Shard-lint the MULTICHIP zoo configs (static analysis only — nothing
+executes on a device unless ``--measure`` is given).
+
+For each config this builds a dryrun-shaped multichip train step (dp×mp
+Megatron-style TP, dp×mp×sep ring attention, sharding×pp pipeline ticks,
+MoE expert-parallel all_to_all), abstractly propagates shardings over its
+jaxpr under the config's mesh (``paddle_tpu.analysis.shard_lint`` — no XLA
+invocation), prints the findings table plus the predicted per-axis
+collective bytes, and (with ``--jsonl``) emits one JSON object per finding.
+``--format sarif`` instead writes a SARIF 2.1.0 document to stdout for CI
+annotations.
+
+``--measure`` additionally compiles each config that this host's backend
+supports (dp-mp, moe on XLA:CPU) through ``profiler.devprof`` and prints
+the predicted-vs-HLO-measured crosscheck rows
+(``analysis.crosscheck_comm`` — the accuracy loop; within 10%, exact for
+explicit shard_map collectives).
+
+``--fixture mismatched-constraint`` re-builds every config with a
+deliberately wrong ``with_sharding_constraint`` injected after the first
+TP matmul: the regression fixture for ``spmd-implicit-resharding`` — the
+run must exit 1 (``tools/run_tests.sh`` gates exactly this).
+
+Exit status: 1 when any finding at/above ``--fail-on`` severity survived
+(default ``error``).
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/shard_lint.py
+        [--models dp-mp dp-mp-sep sharding-pp moe] [--jsonl PATH]
+        [--format table|sarif] [--fixture mismatched-constraint]
+        [--measure] [--fail-on error|warning|never]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# the zoo meshes need 8 virtual devices; flags must land before jax
+# initializes its backend (same forcing as tests/conftest.py)
+if os.environ.get("PADDLE_TPU_HW_TESTS") != "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _mismatch(y_value, mesh, axis):
+    """The injected defect: constrain a TP-sharded activation to a sharding
+    that moves the model-parallel axis onto the batch dim — the propagated
+    sharding disagrees and GSPMD must reshard every step."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        y_value, NamedSharding(mesh, P(axis, None)))
+
+
+def build_dp_mp(fixture=None):
+    """Megatron-style TP MLP under a dp×mp mesh: column-split l1, row-split
+    l2 (partial sums → mp all-reduce), batch sharded over dp, SGD update.
+    The canonical GSPMD config: every collective is partitioner-inserted
+    (fwd mp psum + bwd dp gradient all-reduces) and the propagation must
+    price them within 10% of the compiled HLO."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.utils import unique_name
+
+    mesh = build_mesh({"dp": 2, "mp": 2})
+    with unique_name.guard():
+        paddle.seed(0)
+        l1 = paddle.nn.Linear(32, 64)
+        l2 = paddle.nn.Linear(64, 32)
+    put = jax.device_put
+    l1.weight._value = put(l1.weight._value, NamedSharding(mesh, P(None, "mp")))
+    l1.bias._value = put(l1.bias._value, NamedSharding(mesh, P("mp")))
+    l2.weight._value = put(l2.weight._value, NamedSharding(mesh, P("mp", None)))
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=list(l1.parameters()) + list(l2.parameters()))
+
+    def train_step(x, y):
+        h = paddle.nn.functional.relu(l1(x))
+        if fixture == "mismatched-constraint":
+            h._value = _mismatch(h._value, mesh, "mp")
+        out = l2(h)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "dp_mp_train_step"
+    step = CompiledStep(train_step, stateful=[l1, l2, opt],
+                        donate_state=True)
+    rng = np.random.RandomState(0)
+    x = Tensor(put(jnp.asarray(rng.randn(16, 32), jnp.float32),
+                   NamedSharding(mesh, P("dp", None))))
+    y = Tensor(put(jnp.asarray(rng.randn(16, 32), jnp.float32),
+                   NamedSharding(mesh, P("dp", None))))
+    return step, (x, y), mesh, True  # measurable on XLA:CPU
+
+
+def build_dp_mp_sep(fixture=None):
+    """dp×mp×sep: ring attention — shard_map manual over the sep axis
+    rotating KV blocks with ppermute (exact ring-model bytes), dp sharding
+    the batch and a TP-sharded projection around it. Static-only on
+    XLA:CPU (the partial-manual region needs PartitionId — real TPUs
+    partition it fine)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.utils import unique_name
+
+    mesh = build_mesh({"dp": 2, "mp": 2, "sep": 2})
+    sep = 2
+    with unique_name.guard():
+        paddle.seed(0)
+        proj = paddle.nn.Linear(16, 16)
+    proj.weight._value = jax.device_put(
+        proj.weight._value, NamedSharding(mesh, P(None, "mp")))
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=proj.parameters())
+
+    def ring(qv, kv, vv):
+        def inner(q, k, v):
+            # per-rank sequence block; rotate KV around the sep ring
+            def tick(carry, _):
+                k_blk, v_blk, acc = carry
+                acc = acc + jnp.einsum("bqd,bkd->bqk", q, k_blk) @ v_blk
+                k_blk = lax.ppermute(k_blk, "sep", [(0, 1), (1, 0)])
+                v_blk = lax.ppermute(v_blk, "sep", [(0, 1), (1, 0)])
+                return (k_blk, v_blk, acc), 0.0
+
+            acc0 = jnp.zeros_like(q)
+            (_, _, acc), _ = lax.scan(tick, (k, v, acc0),
+                                      jnp.arange(sep))
+            return acc / q.shape[1]
+
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P("dp", "sep"), P("dp", "sep"), P("dp", "sep")),
+            out_specs=P("dp", "sep"), check_vma=False)(qv, kv, vv)
+
+    from paddle_tpu.ops.dispatch import apply_op
+
+    def train_step(x, y):
+        h = proj(x)
+        if fixture == "mismatched-constraint":
+            h._value = _mismatch(h._value, mesh, "mp")
+        attn = apply_op("ring_attn", ring, (h, h, h), {})
+        loss = ((attn - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "dp_mp_sep_train_step"
+    step = CompiledStep(train_step, stateful=[proj, opt], donate_state=True)
+    rng = np.random.RandomState(1)
+    mk = lambda: Tensor(jax.device_put(  # noqa: E731
+        jnp.asarray(rng.randn(4, 8, 16), jnp.float32),
+        NamedSharding(mesh, P("dp", "sep", None))))
+    return step, (mk(), mk()), mesh, False
+
+
+def build_sharding_pp(fixture=None):
+    """sharding×pp: the pipeline's tick structure — microbatch activations
+    rotated stage-to-stage with ppermute inside a scan over the schedule
+    (T = M + pp − 1 ticks), stage weights sharded over pp, optimizer
+    state ZeRO-sharded over the data axis. Static-only on XLA:CPU
+    (PartitionId, as above)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.framework.tensor import Parameter, Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.ops.dispatch import apply_op
+
+    pp, M, d = 2, 4, 16
+    mesh = build_mesh({"sharding": 4, "pp": pp})
+    rng = np.random.RandomState(2)
+    holder = paddle.nn.Layer()
+    w = Parameter(jax.device_put(
+        jnp.asarray(rng.randn(pp, d, d) * 0.2, jnp.float32),
+        NamedSharding(mesh, P("pp"))))
+    holder.add_parameter("stages", w)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
+
+    def pipe(xv, wv):
+        def inner(h_m, ws):
+            s = lax.axis_index("pp")
+            T = M + pp - 1
+
+            def tick(buf, t):
+                x0 = jnp.take(h_m, jnp.clip(t, 0, M - 1), axis=0)
+                x_in = jnp.where(s == 0, x0, buf)
+                y = jnp.tanh(x_in @ ws[0])
+                nxt = lax.ppermute(y, "pp",
+                                   [(i, (i + 1) % pp) for i in range(pp)])
+                return nxt, y
+
+            _, ys = lax.scan(tick, jnp.zeros_like(h_m[0]), jnp.arange(T))
+            outs = ys[pp - 1:]
+            mask = (s == pp - 1).astype(outs.dtype)
+            return lax.psum(outs * mask, "pp")
+
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(P(None, "sharding"), P("pp")),
+            out_specs=P(None, "sharding"), check_vma=False)(xv, wv)
+
+    def train_step(x):
+        out = apply_op("pipe_ticks", pipe, (x, w), {})
+        loss = ((out - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "sharding_pp_train_step"
+    step = CompiledStep(train_step, stateful=[holder, opt],
+                        donate_state=True)
+    x = Tensor(jax.device_put(
+        jnp.asarray(rng.randn(M, 8, d), jnp.float32),
+        NamedSharding(mesh, P(None, "sharding", None))))
+    return step, (x,), mesh, False
+
+
+def build_moe(fixture=None):
+    """MoE expert parallelism: stacked expert weights sharded over the ep
+    axis, token exchange as the explicit shard_map all_to_all pair
+    (dispatch + combine, the reference ``global_scatter``/``global_gather``
+    comm pattern) — every collective is explicit, so the prediction is
+    EXACT against the compiled HLO."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.framework.tensor import Parameter, Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.ops.dispatch import apply_op
+
+    ep, d, cap = 8, 16, 4
+    mesh = build_mesh({"ep": ep})
+    rng = np.random.RandomState(0)
+    holder = paddle.nn.Layer()
+    w = Parameter(jax.device_put(
+        jnp.asarray(rng.randn(ep, d, d) * 0.1, jnp.float32),
+        NamedSharding(mesh, P("ep"))))
+    holder.add_parameter("experts", w)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=[w])
+
+    def moe(xv, wv):
+        def inner(xs, ws):
+            # xs [E, cap, d] rows grouped by destination expert
+            recv = lax.all_to_all(xs, "ep", split_axis=0, concat_axis=1,
+                                  tiled=True)
+            h = jax.nn.relu(jnp.einsum("ecd,df->ecf", recv, ws[0]))
+            return lax.all_to_all(h, "ep", split_axis=1, concat_axis=0,
+                                  tiled=True)
+
+        return jax.shard_map(inner, mesh=mesh, in_specs=(P("ep"), P("ep")),
+                             out_specs=P("ep"), check_vma=False)(xv, wv)
+
+    def train_step(x):
+        if fixture == "mismatched-constraint":
+            x = paddle.framework.tensor.Tensor(
+                _mismatch(x._value, mesh, None))
+        out = apply_op("moe_sm", moe, (x, w), {})
+        loss = ((out - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "moe_train_step"
+    step = CompiledStep(train_step, stateful=[holder, opt],
+                        donate_state=True)
+    x = Tensor(jax.device_put(
+        jnp.asarray(rng.randn(ep * ep, cap, d), jnp.float32),
+        NamedSharding(mesh, P("ep"))))
+    return step, (x,), mesh, True  # measurable on XLA:CPU
+
+
+ZOO = {
+    "dp-mp": build_dp_mp,
+    "dp-mp-sep": build_dp_mp_sep,
+    "sharding-pp": build_sharding_pp,
+    "moe": build_moe,
+}
+
+
+def lint_zoo(models, fixture=None, measure=False, out=sys.stdout):
+    """Returns ``[(name, LintReport, ShardingAnalysis, crosscheck_rows)]``
+    (import-friendly: the tests drive this directly)."""
+    from paddle_tpu import analysis
+
+    results = []
+    for name in models:
+        step, batch, mesh, measurable = ZOO[name](fixture=fixture)
+        report = analysis.lint_step(step, *batch, mesh=mesh)
+        sa = report.sharding  # the propagation lint_step ran
+        print(f"\n== {name} ({step.name}) ==", file=out)
+        print(report.table(), file=out)
+        if sa is not None:
+            print(sa.table(), file=out)
+        rows = None
+        if measure and measurable:
+            from paddle_tpu.profiler import devprof
+
+            rep = devprof.device_report(step, *batch, register=False)
+            rows = analysis.crosscheck_comm(sa, rep)
+            for r in rows:
+                ratio = ("n/a" if r["ratio"] is None
+                         else f"{r['ratio']:.3f}")
+                print(f"crosscheck: axis={r['axis']} "
+                      f"predicted={r['predicted_bytes']:.0f} "
+                      f"measured={r['measured_bytes']:.0f} "
+                      f"ratio={ratio} agrees={r['agrees']}", file=out)
+        elif measure:
+            print(f"crosscheck: skipped ({name} needs a backend with "
+                  f"SPMD PartitionId — static prediction only on this "
+                  f"host)", file=out)
+        results.append((name, report, sa, rows))
+    return results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--models", nargs="+",
+                    default=["dp-mp", "dp-mp-sep", "sharding-pp", "moe"],
+                    choices=sorted(ZOO))
+    ap.add_argument("--jsonl", default=None,
+                    help="write one JSON object per finding to this path")
+    ap.add_argument("--format", default="table",
+                    choices=["table", "sarif"],
+                    help="sarif: emit a SARIF 2.1.0 document on stdout "
+                         "(CI annotations) instead of tables")
+    ap.add_argument("--fixture", default=None,
+                    choices=["mismatched-constraint"],
+                    help="inject a wrong with_sharding_constraint after "
+                         "the first TP matmul (spmd-implicit-resharding "
+                         "regression; the run must exit 1)")
+    ap.add_argument("--measure", action="store_true",
+                    help="also compile measurable configs via devprof and "
+                         "print the predicted-vs-HLO crosscheck")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "never"],
+                    help="exit 1 when findings at/above this severity "
+                         "exist")
+    args = ap.parse_args(argv)
+
+    sink = open(os.devnull, "w") if args.format == "sarif" else sys.stdout
+    results = lint_zoo(args.models, fixture=args.fixture,
+                       measure=args.measure, out=sink)
+
+    if args.format == "sarif":
+        from paddle_tpu.analysis import sarif_report
+
+        findings = [f for _, report, _, _ in results for f in report]
+        json.dump(sarif_report(findings, tool="paddle-tpu-shard-lint"),
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
+
+    if args.jsonl:
+        with open(args.jsonl, "w") as fh:
+            for name, report, _, _ in results:
+                for f in report:
+                    fh.write(json.dumps({"model": name, **f.as_dict()},
+                                        sort_keys=True) + "\n")
+        print(f"wrote {sum(len(r) for _, r, _, _ in results)} findings to "
+              f"{args.jsonl}", file=sink)
+
+    n_err = sum(len(r.errors) for _, r, _, _ in results)
+    n_warn = sum(len(r.warnings) for _, r, _, _ in results)
+    bad_cross = sum(1 for _, _, _, rows in results
+                    for r in (rows or ()) if not r["agrees"])
+    print(f"\nshard lint: {n_err} error(s), {n_warn} warning(s), "
+          f"{bad_cross} crosscheck disagreement(s) across "
+          f"{len(results)} config(s)", file=sink)
+    if args.fail_on == "never":
+        return 0
+    gate = n_err + bad_cross + (n_warn if args.fail_on == "warning" else 0)
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
