@@ -316,6 +316,13 @@ class TelemetryLogger(Callback):
     train batches (render with ``tools/telemetry_report.py``), and the
     phase-breakdown table prints at train end.
 
+    SLO monitoring rides along: pass ``slo=`` a list of spec strings (see
+    ``profiler.slo`` — e.g. ``"step.time_s < 0.5"``,
+    ``"phase.data_wait p95 < 0.1"``) or a prebuilt
+    :class:`~paddle_tpu.profiler.slo.SLOMonitor`, and the callback samples
+    it every ``log_freq`` batches; burn-rate alerts fire through the
+    monitor's sinks mid-run and the SLO table prints at train end.
+
     Args:
         log_dir: JSONL output directory; ``None`` keeps the registry
             in-memory only (``telemetry.report()`` still works).
@@ -323,15 +330,19 @@ class TelemetryLogger(Callback):
         print_report: print ``telemetry.report()`` on train end.
         reset_on_begin: clear the registry at train begin so the report
             covers exactly this run.
+        slo: SLO spec strings (or an ``SLOMonitor``) sampled at the export
+            cadence; the monitor stays on ``self.slo_monitor``.
     """
 
     def __init__(self, log_dir=None, log_freq=10, print_report=True,
-                 reset_on_begin=True):
+                 reset_on_begin=True, slo=None):
         super().__init__()
         self.log_dir = log_dir
         self.log_freq = max(1, int(log_freq or 1))
         self.print_report = print_report
         self.reset_on_begin = reset_on_begin
+        self._slo_arg = slo
+        self.slo_monitor = None
         self._writer = None
         self._train_step = 0
         self._enabled_here = False
@@ -356,12 +367,21 @@ class TelemetryLogger(Callback):
         if not telemetry.enabled():
             telemetry.enable()
             self._enabled_here = True
+        if self._slo_arg is not None and self.slo_monitor is None:
+            from ..profiler.slo import SLOMonitor
+
+            self.slo_monitor = (
+                self._slo_arg if isinstance(self._slo_arg, SLOMonitor)
+                else SLOMonitor(self._slo_arg))
 
     def on_train_batch_end(self, step, logs=None):
         self._train_step += 1
-        if self.log_dir and self._train_step % self.log_freq == 0:
-            self._tm().get_telemetry().export_scalars(
-                self._w(), step=self._train_step)
+        if self._train_step % self.log_freq == 0:
+            if self.log_dir:
+                self._tm().get_telemetry().export_scalars(
+                    self._w(), step=self._train_step)
+            if self.slo_monitor is not None:
+                self.slo_monitor.check()
 
     def on_train_end(self, logs=None):
         telemetry = self._tm()
@@ -371,8 +391,12 @@ class TelemetryLogger(Callback):
         if self._writer is not None:
             self._writer.close()
             self._writer = None
+        if self.slo_monitor is not None:
+            self.slo_monitor.check()
         if self.print_report:
             telemetry.report()
+            if self.slo_monitor is not None:
+                self.slo_monitor.report()
         if self._enabled_here:
             telemetry.disable()
             self._enabled_here = False

@@ -358,7 +358,7 @@ class Model:
 
         from ..io.device_loader import DeviceLoader
         from ..metric import AsyncMetricBuffer
-        from ..profiler import telemetry
+        from ..profiler import telemetry, tracing
 
         for m in self._metrics:
             m.reset()
@@ -385,6 +385,15 @@ class Model:
         tm_on = telemetry.enabled()
         if tm_on:
             telemetry.step_begin()
+        # request-scoped tracing, train-side: the epoch roots a trace and
+        # every step runs inside a child span — the same span model the
+        # serving tier uses, so one export holds both. Compile events
+        # (CompiledStep) parent under the active step span.
+        tr_on = tracing.enabled()
+        epoch_span = None
+        if tr_on:
+            epoch_span = tracing.start_span(
+                f"{mode}_epoch", attrs={"epoch": epoch, "mode": mode})
         for step, batch in enumerate(DeviceLoader(src), start=skip_steps):
             batch = _to_list(batch)
             # convention: trailing element(s) are labels when a loss is set
@@ -393,10 +402,13 @@ class Model:
             else:
                 ins, labs = batch, []
             cbks.on_batch_begin(mode, step, logs)
-            if mode == "train":
-                loss, outs, labs = self._train_batch_device(ins, labs)
-            else:
-                loss, outs, labs = self._eval_batch_device(ins, labs)
+            with tracing.span(f"{mode}_step", parent=epoch_span,
+                              attrs={"step": step}) if tr_on \
+                    else tracing.NULL_SPAN:
+                if mode == "train":
+                    loss, outs, labs = self._train_batch_device(ins, labs)
+                else:
+                    loss, outs, labs = self._eval_batch_device(ins, labs)
             buf.append(loss)
             # fence at log_freq boundaries; also once at the first step so
             # logs['loss'] exists from the first callback onward (between
@@ -424,6 +436,8 @@ class Model:
             if tm_on:
                 telemetry.step_begin()  # roll the phase record over
         buf.drain()  # epoch-end fence
+        if epoch_span is not None:
+            epoch_span.set_attr("samples", total_samples).end()
         if tm_on:
             telemetry.step_end()
         if buf.values:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..framework import random as rnd
 from ..profiler import telemetry as _telemetry
+from ..profiler import tracing as _tracing
 from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..optimizer.optimizer import Optimizer
@@ -338,7 +339,11 @@ class CompiledStep:
             # visible in the PRE-step state pytree; after one real step the
             # state has stabilized and the defect is invisible statically
             _analysis().autolint(self, args, kwargs, enabled=True)
-        if not _telemetry.enabled():
+        tm_on = _telemetry.enabled()
+        # trace-context compile attribution: only worth timing when a span
+        # is actually current (a request's prefill, a train step, ...)
+        tr_on = _tracing.enabled() and _tracing.current_span() is not None
+        if not tm_on and not tr_on:
             return self._invoke(args, kwargs)
         marker = self._trace_marker
         marker["traced"] = False
@@ -346,7 +351,7 @@ class CompiledStep:
         # traces, devprof harvests against it — the real buffers may be
         # donated/consumed by then. Skipped once the harvest has run.
         sig = None
-        if not getattr(self, "_devprof_done", False) \
+        if tm_on and not getattr(self, "_devprof_done", False) \
                 and _devprof().auto_harvest_enabled():
             try:
                 sig = _devprof()._shape_only((args, kwargs))
@@ -355,18 +360,26 @@ class CompiledStep:
         t0 = time.perf_counter_ns()
         out = self._invoke(args, kwargs)
         t1 = time.perf_counter_ns()
-        tm = _telemetry.get_telemetry()
         if marker["traced"]:
-            # traced this call: wall time is dominated by trace+XLA compile;
-            # repeated hits here for one step name = shape/dtype churn
-            tm.note_compile(self.name, t0, t1)
-            if sig is not None:
-                # first compile: harvest the DeviceCostReport (memory/cost/
-                # comm ground truth) into the telemetry registry
-                _devprof().maybe_harvest_on_compile(self, sig[0], sig[1])
-        else:
+            if tm_on:
+                # traced this call: wall time is dominated by trace+XLA
+                # compile; repeated hits here for one step name = shape/
+                # dtype churn
+                tm = _telemetry.get_telemetry()
+                tm.note_compile(self.name, t0, t1)
+                if sig is not None:
+                    # first compile: harvest the DeviceCostReport (memory/
+                    # cost/comm ground truth) into the telemetry registry
+                    _devprof().maybe_harvest_on_compile(self, sig[0], sig[1])
+            if tr_on:
+                # a `compile` child span under the current request/train
+                # span: the trace export shows who paid this compile
+                idx = (_telemetry.get_telemetry().compile_counts()
+                       .get(self.name) if tm_on else None)
+                _tracing.note_compile(self.name, t0, t1, compile_index=idx)
+        elif tm_on:
             # cache hit: host-side enqueue of the async device execution
-            tm.add_phase("dispatch", t0, t1)
+            _telemetry.get_telemetry().add_phase("dispatch", t0, t1)
         return out
 
     def analyze(self, *args, **kwargs):

@@ -1,6 +1,9 @@
 """paddle.profiler (reference ``python/paddle/profiler/__init__.py``)."""
 from . import devprof  # noqa: F401
+from . import export  # noqa: F401
+from . import slo  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import tracing  # noqa: F401
 from .profiler import (  # noqa: F401
     Profiler,
     ProfilerState,
@@ -20,4 +23,5 @@ __all__ = [
     "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "Profiler", "RecordEvent",
     "load_profiler_result", "SortedKeys", "telemetry", "devprof",
+    "tracing", "export", "slo",
 ]

@@ -1,0 +1,180 @@
+"""OpenMetrics exposition over the telemetry registry.
+
+A fleet monitor (Prometheus-compatible) can't consume ``telemetry.report()``
+tables; this renders the registry in the OpenMetrics text format and serves
+it from a stdlib ``http.server`` endpoint:
+
+* counters → ``counter`` families (``serve.decode_steps`` →
+  ``serve_decode_steps_total``);
+* gauges → ``gauge`` families (``step.time_s`` → ``step_time_s``);
+* histograms (``observe()``/phase timings) → ``summary`` families carrying
+  the *exact* running ``_count``/``_sum`` (so scraped rates are correct)
+  alongside ``quantile="0.5"``/``"0.95"`` samples from the bounded
+  reservoirs (see ``Telemetry.histogram_stats``).
+
+The endpoint is opt-in (``telemetry.serve_metrics(port=...)`` /
+:func:`serve_metrics`) and renders on demand inside the GET handler — the
+serving/training hot paths never see it, preserving the
+zero-overhead-when-disabled telemetry contract. ``tools/metrics_scrape.py``
+is the stdlib round-trip scraper/parser used by the CI smoke.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+__all__ = [
+    "openmetrics_name",
+    "render_openmetrics",
+    "MetricsServer",
+    "serve_metrics",
+    "CONTENT_TYPE",
+]
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+#: reservoir quantiles exposed on summary families
+QUANTILES = (0.5, 0.95)
+
+
+def openmetrics_name(name):
+    """Registry key → OpenMetrics metric name (``serve.ttft_s`` →
+    ``serve_ttft_s``). Metric names must match
+    ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    out = _NAME_RE.sub("_", str(name))
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v):
+    """Sample value formatting: integers bare, floats via repr (full
+    precision — the round-trip parser must reproduce exact counts/sums)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _esc_help(text):
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def render_openmetrics(telemetry=None):
+    """The registry as OpenMetrics text (terminated by ``# EOF``). Pass a
+    :class:`~paddle_tpu.profiler.telemetry.Telemetry` or default to the
+    process-wide one. Works whether or not collection is currently
+    enabled — it renders whatever the registry holds."""
+    if telemetry is None:
+        from . import telemetry as _telemetry
+
+        telemetry = _telemetry.get_telemetry()
+    counters = telemetry.counters()
+    gauges = telemetry.gauges()
+    hists = telemetry.histogram_stats(include_phases=True)
+
+    lines = []
+    used = set()
+
+    def _family(raw, kind):
+        fam = openmetrics_name(raw)
+        if kind == "counter" and fam.endswith("_total"):
+            fam = fam[: -len("_total")]
+        # two registry keys may sanitize to one name; suffix to keep
+        # families unique rather than emitting an invalid exposition
+        base, n = fam, 2
+        while fam in used:
+            fam = f"{base}_{n}"
+            n += 1
+        used.add(fam)
+        lines.append(f"# TYPE {fam} {kind}")
+        lines.append(f"# HELP {fam} "
+                     f"{_esc_help(f'paddle_tpu telemetry {kind} {raw!r}')}")
+        return fam
+
+    for raw in sorted(counters):
+        fam = _family(raw, "counter")
+        lines.append(f"{fam}_total {_fmt(counters[raw])}")
+    for raw in sorted(gauges):
+        fam = _family(raw, "gauge")
+        lines.append(f"{fam} {_fmt(gauges[raw])}")
+    for raw in sorted(hists):
+        st = hists[raw]
+        fam = _family(raw, "summary")
+        for q in QUANTILES:
+            key = f"p{int(q * 100)}"
+            if key in st:
+                lines.append(f'{fam}{{quantile="{q}"}} {_fmt(st[key])}')
+        lines.append(f"{fam}_count {_fmt(st.get('count', 0))}")
+        lines.append(f"{fam}_sum {_fmt(st.get('sum', 0.0))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Background ``/metrics`` endpoint over the telemetry registry.
+
+    ``MetricsServer(port=0)`` binds an ephemeral port (read it back from
+    ``.port``), serves GETs on ``/metrics`` (and ``/``) from a daemon
+    thread, and tears down on :meth:`close` (context-manager supported).
+    Rendering happens inside the request handler; an idle endpoint costs
+    nothing on the instrumented paths.
+    """
+
+    def __init__(self, port=0, addr="127.0.0.1", telemetry=None):
+        import http.server
+
+        registry = telemetry
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_error(404, "try /metrics")
+                    return
+                try:
+                    body = render_openmetrics(registry).encode("utf-8")
+                except Exception as e:  # pragma: no cover - render bug guard
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((addr, int(port)),
+                                                      _Handler)
+        self._httpd.daemon_threads = True
+        self.addr = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pt-metrics",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://{self.addr}:{self.port}/metrics"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve_metrics(port=0, addr="127.0.0.1", telemetry=None):
+    """Start the ``/metrics`` endpoint; returns the :class:`MetricsServer`
+    (``.url`` for the scrape target, ``.close()`` to stop). Also exposed as
+    ``profiler.telemetry.serve_metrics`` for discoverability."""
+    return MetricsServer(port=port, addr=addr, telemetry=telemetry)

@@ -56,6 +56,7 @@ __all__ = [
     "step_end",
     "report",
     "summary",
+    "serve_metrics",
 ]
 
 #: canonical per-step pipeline phases, in pipeline order
@@ -145,6 +146,9 @@ class Telemetry(Monitor):
         # bounded per-phase sample reservoirs for the p50/p95 columns
         # (Monitor.add only keeps count/sum/min/max)
         self._phase_samples = {}
+        # same for observe() histograms (serve.ttft_s etc.): Monitor keeps
+        # the EXACT running count/sum, the reservoir adds p50/p95
+        self._hist_samples = {}
         self._current = None
         self._next_step = 0
         self._compiles = {}
@@ -173,9 +177,12 @@ class Telemetry(Monitor):
                 del self._gauges[k]
 
     def observe(self, name, seconds):
-        """Time-histogram sample (Monitor count/sum/min/max under `name`)."""
+        """Time-histogram sample: exact running count/sum/min/max (Monitor)
+        plus a bounded reservoir for the p50/p95 columns."""
         with self._lock:
             self.add(name, seconds)
+            self._hist_samples.setdefault(
+                name, collections.deque(maxlen=2048)).append(float(seconds))
 
     def counters(self):
         with self._lock:
@@ -292,6 +299,52 @@ class Telemetry(Monitor):
                 out[name] = s
         return out
 
+    def _reservoir(self, name):
+        """The bounded sample reservoir behind histogram ``name`` (phase
+        histograms live under their short name). Caller holds the lock."""
+        if name.startswith("phase."):
+            return self._phase_samples.get(name[len("phase."):], ())
+        return self._hist_samples.get(name, ())
+
+    def histogram_stats(self, include_phases=False):
+        """{name: {count, sum, min, max, mean, p50, p95}} for every
+        ``observe()`` histogram — count/sum are the EXACT running totals
+        (scraped rates stay correct), p50/p95 come from the bounded
+        reservoirs. ``include_phases`` folds the ``phase.*`` timings in
+        (the OpenMetrics exporter wants one flat view)."""
+        out = {}
+        with self._lock:
+            for key in self.names():
+                if key.startswith("phase.") and not include_phases:
+                    continue
+                s = self.get(key)
+                s["mean"] = s["sum"] / s["count"] if s.get("count") else 0.0
+                xs = sorted(self._reservoir(key))
+                s["p50"] = self._percentile(xs, 0.50)
+                s["p95"] = self._percentile(xs, 0.95)
+                out[key] = s
+        return out
+
+    def stat(self, name, stat):
+        """One scalar statistic of histogram ``name``: ``count``/``sum``/
+        ``min``/``max``/``mean`` from the exact running totals, ``p<NN>``
+        from the reservoir. Returns None when there are no samples (the
+        SLO monitor skips the check rather than paging on nothing)."""
+        with self._lock:
+            s = self.get(name)
+            if not s.get("count"):
+                return None
+            if stat == "mean":
+                return s["sum"] / s["count"]
+            if stat in s:
+                return s[stat]
+            if stat.startswith("p"):
+                xs = sorted(self._reservoir(name))
+                if not xs:
+                    return None
+                return self._percentile(xs, float(stat[1:]) / 100.0)
+        raise ValueError(f"unknown histogram stat {stat!r}")
+
     def chrome_spans(self):
         """Buffered raw spans as (name, start_ns, end_ns, tid) tuples, on
         the same ``perf_counter_ns`` clock as the profiler's host events."""
@@ -310,6 +363,7 @@ class Telemetry(Monitor):
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "phases": self.phase_stats(),
+                "histograms": self.histogram_stats(),
                 "steps_recorded": len(recs),
                 "step_wall_s": wall,
                 "step_phase_s": per_phase,
@@ -339,6 +393,12 @@ class Telemetry(Monitor):
             writer.add_scalar(f"telemetry/phase/{name}/mean_s", s["mean"], step)
             writer.add_scalar(f"telemetry/phase/{name}/p50_s", s["p50"], step)
             writer.add_scalar(f"telemetry/phase/{name}/p95_s", s["p95"], step)
+        for name, s in self.histogram_stats().items():
+            writer.add_scalar(f"telemetry/hist/{name}/count", s["count"], step)
+            writer.add_scalar(f"telemetry/hist/{name}/sum", s["sum"], step)
+            writer.add_scalar(f"telemetry/hist/{name}/mean", s["mean"], step)
+            writer.add_scalar(f"telemetry/hist/{name}/p50", s["p50"], step)
+            writer.add_scalar(f"telemetry/hist/{name}/p95", s["p95"], step)
         for name, v in last_phases.items():
             writer.add_scalar(f"telemetry/step/{name}_s", v, step)
 
@@ -413,6 +473,18 @@ class Telemetry(Monitor):
                 else:
                     lines.append(f"  {k:<38} {v:g}" if isinstance(v, float)
                                  else f"  {k:<38} {v}")
+        if s["histograms"]:
+            # observe() histograms (serve.ttft_s / serve.latency_s / ...):
+            # exact count+sum so rates derived downstream are correct, and
+            # the reservoir percentiles alongside
+            lines.append(f"histograms: {'':<15} {'Count':>8} {'Sum':>12} "
+                         f"{'Mean':>10} {'P50':>10} {'P95':>10}")
+            for k in sorted(s["histograms"]):
+                st = s["histograms"][k]
+                lines.append(
+                    f"  {k:<25} {st['count']:>8} {st['sum']:>12.4f} "
+                    f"{st['mean']:>10.4f} {st['p50']:>10.4f} "
+                    f"{st['p95']:>10.4f}")
         if s["compiles"]:
             lines.append(f"recompiles beyond first: {s['recompile_count']}")
             for k in sorted(s["compiles"]):
@@ -434,6 +506,7 @@ class Telemetry(Monitor):
             self._ring.clear()
             self._spans.clear()
             self._phase_samples.clear()
+            self._hist_samples.clear()
             self._current = None
             self._next_step = 0
             self._compiles.clear()
@@ -490,6 +563,18 @@ def step_begin():
 def step_end():
     if _ENABLED:
         _TELEMETRY.step_end()
+
+
+def serve_metrics(port=0, addr="127.0.0.1"):
+    """Start the opt-in OpenMetrics ``/metrics`` endpoint over this
+    registry (stdlib ``http.server``, ephemeral port by default). Returns
+    the :class:`~paddle_tpu.profiler.export.MetricsServer` — read the
+    bound port from ``.port``, stop with ``.close()``. Rendering happens
+    per scrape in the handler thread; nothing touches the instrumented hot
+    paths, so the zero-overhead-when-disabled contract holds."""
+    from .export import serve_metrics as _serve
+
+    return _serve(port=port, addr=addr, telemetry=_TELEMETRY)
 
 
 def summary():

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 from ..jit.functionalize import CompiledStep
+from ..profiler import tracing as _tracing
 from .kv_cache import (
     MASK_MIN,
     DecodeView,
@@ -195,8 +196,14 @@ class GenerationEngine:
         bucket = pick_bucket(prompt.size, self.prefill_buckets)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :prompt.size] = prompt
-        tok, cache = self._prefill_step(
-            toks, np.int32(prompt.size), np.int32(slot), self.cache)
+        # span nests under the caller's context (a scheduler's per-request
+        # prefill span, or roots its own trace standalone); the compiled
+        # step's compile event lands inside it on a cold bucket
+        with _tracing.span("serve_prefill",
+                           attrs={"slot": int(slot), "bucket": bucket,
+                                  "prompt_tokens": int(prompt.size)}):
+            tok, cache = self._prefill_step(
+                toks, np.int32(prompt.size), np.int32(slot), self.cache)
         self.cache = cache  # donated: the old buffers are consumed
         return int(np.asarray(_leaf(tok)))
 
@@ -204,7 +211,8 @@ class GenerationEngine:
         """One batched decode step: ``last_tokens[b]`` is each slot's most
         recent token. Returns the next token per slot (np int32 [b])."""
         feed = np.asarray(last_tokens, np.int32).reshape(self.max_batch, 1)
-        tok, cache = self._decode_step(feed, self.cache)
+        with _tracing.span("serve_decode"):
+            tok, cache = self._decode_step(feed, self.cache)
         self.cache = cache
         return np.asarray(_leaf(tok))
 
@@ -212,13 +220,16 @@ class GenerationEngine:
         """Greedy single-request generation (slot 0; other slots idle).
         Per-step cost is O(1) in generated length: one ``serve_decode``
         dispatch, no recompiles, no cache copies."""
-        out = [self.prefill(0, prompt_ids)]
-        while len(out) < int(max_new_tokens):
-            if eos_id is not None and out[-1] == eos_id:
-                break
-            feed = np.zeros((self.max_batch,), np.int32)
-            feed[0] = out[-1]
-            out.append(int(self.decode_once(feed)[0]))
+        with _tracing.span("generate",
+                           attrs={"prompt_tokens": len(prompt_ids),
+                                  "max_new_tokens": int(max_new_tokens)}):
+            out = [self.prefill(0, prompt_ids)]
+            while len(out) < int(max_new_tokens):
+                if eos_id is not None and out[-1] == eos_id:
+                    break
+                feed = np.zeros((self.max_batch,), np.int32)
+                feed[0] = out[-1]
+                out.append(int(self.decode_once(feed)[0]))
         return out
 
     def lengths(self):

@@ -25,6 +25,25 @@ Determinism contract (regression-tested): with a fixed arrival stream and
 seeded model, the admit/evict event log and every generated sequence are
 identical run to run — slots are a min-heap, the active set is iterated in
 slot order, and decoding is greedy.
+
+Request-scoped tracing (``profiler/tracing.py``, opt-in): ``submit`` mints
+the request's trace — a ``request`` root span plus a ``queue`` child that
+closes at admit; the prefill runs inside a ``prefill`` child (so the
+engine's span and any compile events parent under it); every decode tick
+records one ``decode_token`` span per *active* request over the shared
+batched-dispatch interval (each carries a ``decode_span`` attr naming the
+shared ``decode_step`` span it rode); evict closes the root with the
+finish reason and latency stats. One JSONL export reconstructs the
+request's full life by filtering its trace id.
+
+Gauge lifecycle (mirrors the DeviceLoader fix): ``serve.requests_in_flight``
+and ``serve.queue_depth`` are retired when ``run()`` drains the batch and
+on :meth:`Scheduler.shutdown` so a dead scheduler can't leave stale
+in-flight stats in ``report()`` or a ``/metrics`` scrape.
+
+SLO hook: pass ``slo=SLOMonitor([...])`` and the scheduler samples it
+every ``slo_check_every`` ticks (plus once at drain) — burn-rate alerts
+fire from inside the serving loop, no sidecar needed.
 """
 from __future__ import annotations
 
@@ -37,6 +56,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..profiler import telemetry as _telemetry
+from ..profiler import tracing as _tracing
 
 __all__ = ["Request", "Scheduler"]
 
@@ -59,6 +79,14 @@ class Request:
     first_token_ns: int | None = None
     done_ns: int | None = None
     finish_reason: str | None = None
+    # tracing (None unless profiler.tracing is enabled at submit)
+    trace_span: object = field(default=None, repr=False, compare=False)
+    queue_span: object = field(default=None, repr=False, compare=False)
+
+    @property
+    def trace_id(self):
+        """The request's trace id (None when tracing was off at submit)."""
+        return getattr(self.trace_span, "trace_id", None)
 
     @property
     def finished(self):
@@ -90,7 +118,7 @@ class Scheduler:
     """Slot-based continuous-batching scheduler over a
     :class:`~paddle_tpu.serving.GenerationEngine`."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, slo=None, slo_check_every=8):
         self.engine = engine
         self.queue = deque()
         self.active = {}  # slot -> Request
@@ -101,6 +129,9 @@ class Scheduler:
         self._step_idx = 0
         self.decode_steps = 0
         self.slot_steps = 0
+        self.slo = slo
+        self.slo_check_every = max(1, int(slo_check_every))
+        self._session_span = None
 
     # -- submission ----------------------------------------------------------
     def submit(self, request: Request):
@@ -118,6 +149,15 @@ class Scheduler:
                 f"prompt ({n}) + max_new_tokens ({request.max_new_tokens}) "
                 f"exceeds the cache capacity max_len={self.engine.max_len}")
         request.submit_ns = time.perf_counter_ns()
+        if _tracing.enabled():
+            # the request's whole life lives under this root span; the
+            # queue child measures submit→admit wait explicitly
+            request.trace_span = _tracing.start_span(
+                "request", trace_id=_tracing.get_tracer().new_trace_id(),
+                attrs={"rid": request.rid, "prompt_tokens": n,
+                       "max_new_tokens": request.max_new_tokens})
+            request.queue_span = _tracing.start_span(
+                "queue", parent=request.trace_span)
         self.queue.append(request)
         if _telemetry.enabled():
             tm = _telemetry.get_telemetry()
@@ -130,6 +170,10 @@ class Scheduler:
         """One scheduler tick: admit → batched decode → evict. Returns the
         requests that finished during this tick."""
         tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        tr = _tracing.enabled()
+        if tr and self._session_span is None:
+            self._session_span = _tracing.start_span(
+                "serve_session", attrs={"max_batch": self.engine.max_batch})
         done_now = []
 
         # admit: fill free slots from the queue (FIFO, lowest slot first)
@@ -137,9 +181,22 @@ class Scheduler:
             req = self.queue.popleft()
             slot = heapq.heappop(self._free)
             req.slot = slot
-            tok = self.engine.prefill(slot, req.prompt)
+            prefill_span = None
+            if tr and req.trace_span is not None:
+                if req.queue_span is not None:
+                    req.queue_span.end()
+                prefill_span = _tracing.start_span(
+                    "prefill", parent=req.trace_span,
+                    attrs={"slot": slot, "prompt_tokens": len(req.prompt),
+                           "sched_step": self._step_idx})
+            # activated so the engine's serve_prefill span (and the bucket
+            # compile, if this prompt hits a cold bucket) parent under it
+            with _tracing.activate(prefill_span):
+                tok = self.engine.prefill(slot, req.prompt)
             req.first_token_ns = time.perf_counter_ns()
             req.tokens.append(tok)
+            if prefill_span is not None:
+                prefill_span.set_attr("token", tok).end()
             self.active[slot] = req
             self.events.append((self._step_idx, "admit", req.rid, slot))
             if tm is not None:
@@ -154,7 +211,16 @@ class Scheduler:
             feed = np.zeros((self.engine.max_batch,), np.int32)
             for slot, req in self.active.items():
                 feed[slot] = req.tokens[-1]
-            out = self.engine.decode_once(feed)
+            decode_span = None
+            if tr:
+                decode_span = _tracing.start_span(
+                    "decode_step", parent=self._session_span,
+                    attrs={"active": len(self.active),
+                           "sched_step": self._step_idx})
+            with _tracing.activate(decode_span):
+                out = self.engine.decode_once(feed)
+            if decode_span is not None:
+                decode_span.end()
             self.decode_steps += 1
             self.slot_steps += len(self.active)
             if tm is not None:
@@ -164,6 +230,17 @@ class Scheduler:
             for slot in sorted(self.active):
                 req = self.active[slot]
                 req.tokens.append(int(out[slot]))
+                if decode_span is not None and req.trace_span is not None:
+                    # the batched dispatch is SHARED: one span per active
+                    # request over the same interval, linked to the shared
+                    # decode_step span — per-token intervals per request
+                    _tracing.get_tracer().record(
+                        "decode_token", decode_span.start_ns,
+                        decode_span.end_ns, parent=req.trace_span,
+                        attrs={"slot": slot, "token": req.tokens[-1],
+                               "index": len(req.tokens) - 1,
+                               "decode_span": decode_span.span_id,
+                               "decode_trace": decode_span.trace_id})
                 if self._exhausted(req):
                     done_now.append(self._evict(req))
 
@@ -171,18 +248,46 @@ class Scheduler:
         if tm is not None:
             tm.set_gauge("serve.requests_in_flight", len(self.active))
             tm.set_gauge("serve.queue_depth", len(self.queue))
+        if self.slo is not None and self._step_idx % self.slo_check_every == 0:
+            self.slo.check()
         return done_now
 
     def run(self, max_steps=None):
         """Drive ``step()`` until the queue and the batch drain (or
-        ``max_steps`` ticks elapse); returns all finished requests."""
+        ``max_steps`` ticks elapse); returns all finished requests. A full
+        drain retires the in-flight gauges (they'd otherwise report the
+        last tick's values forever) and takes a final SLO sample."""
         steps = 0
         while self.queue or self.active:
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        if not self.queue and not self.active:
+            self._retire_gauges()
+            if self.slo is not None:
+                self.slo.check()
         return self.finished
+
+    def _retire_gauges(self):
+        """Drop the lifecycle gauges (NOT the counters/histograms): a
+        drained or shut-down scheduler must not leave a stale queue depth
+        in ``report()`` or a ``/metrics`` scrape — the DeviceLoader
+        stale-gauge fix, applied to serving."""
+        tm = _telemetry.get_telemetry()
+        tm.clear_gauge("serve.requests_in_flight")
+        tm.clear_gauge("serve.queue_depth")
+
+    def shutdown(self):
+        """Explicit teardown: retire the serve gauges and close the
+        tracing session span. Safe to call repeatedly; the scheduler stays
+        usable (a later ``step()`` republishes gauges and reopens a
+        session span)."""
+        self._retire_gauges()
+        if self._session_span is not None:
+            self._session_span.set_attr("decode_steps", self.decode_steps)
+            self._session_span.end()
+            self._session_span = None
 
     # -- bookkeeping ---------------------------------------------------------
     def _exhausted(self, req):
@@ -200,6 +305,14 @@ class Scheduler:
         heapq.heappush(self._free, req.slot)
         self.events.append((self._step_idx, "evict", req.rid, req.slot))
         self.finished.append(req)
+        if req.trace_span is not None:
+            req.trace_span.set_attr("finish_reason", req.finish_reason)
+            req.trace_span.set_attr("tokens", len(req.tokens))
+            if req.ttft_s is not None:
+                req.trace_span.set_attr("ttft_s", req.ttft_s)
+            if req.latency_s is not None:
+                req.trace_span.set_attr("latency_s", req.latency_s)
+            req.trace_span.end()
         if _telemetry.enabled():
             tm = _telemetry.get_telemetry()
             tm.inc("serve.evicted")

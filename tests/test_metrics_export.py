@@ -1,0 +1,214 @@
+"""OpenMetrics export (ISSUE 8): text renderer, ``/metrics`` endpoint,
+exact histogram ``_count``/``_sum``, and the stdlib scrape round trip.
+
+Contracts under test:
+  * counters render as ``counter`` families with the ``_total`` suffix,
+    gauges as ``gauge``, histograms as ``summary`` carrying EXACT running
+    ``_count``/``_sum`` (acceptance: scraped rates must be correct) plus
+    the reservoir p50/p95 as quantile samples;
+  * the exposition is parseable by ``tools/metrics_scrape.py`` and ends
+    with ``# EOF`` (truncated scrapes fail loudly);
+  * ``telemetry.serve_metrics(port=0)`` binds an ephemeral port, serves a
+    scrapeable exposition over real HTTP, and tears down cleanly;
+  * the endpoint is opt-in and render-on-scrape: nothing changes on the
+    instrumented hot paths (the PR 2 zero-overhead tests stay green).
+"""
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from paddle_tpu.profiler import telemetry
+from paddle_tpu.profiler.export import (
+    CONTENT_TYPE,
+    MetricsServer,
+    openmetrics_name,
+    render_openmetrics,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import metrics_scrape  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _populate():
+    telemetry.enable()
+    tm = telemetry.get_telemetry()
+    tm.inc("serve.decode_steps", 126)
+    tm.inc("serve.tokens_generated", 1024)
+    tm.set_gauge("serve.queue_depth", 4)
+    tm.set_gauge("step.time_s", 0.125)
+    for v in (0.1, 0.2, 0.3, 0.4):
+        tm.observe("serve.ttft_s", v)
+    return tm
+
+
+def test_name_sanitization():
+    assert openmetrics_name("serve.ttft_s") == "serve_ttft_s"
+    assert openmetrics_name("comm.bytes.dp") == "comm_bytes_dp"
+    assert openmetrics_name("9lives") == "_9lives"
+    assert openmetrics_name("a-b c") == "a_b_c"
+
+
+def test_render_families_and_exact_count_sum():
+    _populate()
+    text = render_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert "# TYPE serve_decode_steps counter" in text
+    assert "serve_decode_steps_total 126" in text
+    assert "# TYPE serve_queue_depth gauge" in text
+    assert "serve_queue_depth 4" in text
+    assert "# TYPE serve_ttft_s summary" in text
+    # EXACT running count/sum — not reservoir-derived
+    assert "serve_ttft_s_count 4" in text
+    assert "serve_ttft_s_sum 1\n" in text  # 0.1+0.2+0.3+0.4 == 1.0 exactly
+    assert 'serve_ttft_s{quantile="0.5"}' in text
+    assert 'serve_ttft_s{quantile="0.95"}' in text
+
+
+def test_render_includes_phase_histograms():
+    telemetry.enable()
+    with telemetry.phase_span("dispatch"):
+        pass
+    text = render_openmetrics()
+    assert "# TYPE phase_dispatch summary" in text
+    assert "phase_dispatch_count 1" in text
+
+
+def test_parse_round_trip_preserves_values():
+    tm = _populate()
+    fams = metrics_scrape.parse_openmetrics(render_openmetrics())
+    assert fams["serve_decode_steps"]["type"] == "counter"
+    assert metrics_scrape.sample_value(
+        fams, "serve_decode_steps", "serve_decode_steps_total") == 126
+    assert metrics_scrape.sample_value(fams, "serve_queue_depth") == 4
+    st = tm.get("serve.ttft_s")
+    assert metrics_scrape.sample_value(
+        fams, "serve_ttft_s", "serve_ttft_s_count") == st["count"]
+    assert metrics_scrape.sample_value(
+        fams, "serve_ttft_s", "serve_ttft_s_sum") == pytest.approx(
+            st["sum"], abs=0)
+    assert metrics_scrape.sample_value(
+        fams, "serve_ttft_s", quantile="0.95") == pytest.approx(0.4)
+
+
+def test_parser_rejects_truncated_exposition():
+    with pytest.raises(ValueError, match="EOF"):
+        metrics_scrape.parse_openmetrics("serve_x_total 1\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        metrics_scrape.parse_openmetrics("!! garbage !!\n# EOF\n")
+
+
+def test_render_works_with_collection_disabled():
+    """The renderer reads whatever the registry holds — it must not
+    require the collection flag (an operator scrapes a quiesced process
+    too)."""
+    tm = _populate()
+    telemetry.disable()
+    text = render_openmetrics()
+    assert "serve_decode_steps_total 126" in text
+    assert tm.counters()["serve.decode_steps"] == 126
+
+
+def test_http_endpoint_scrape_and_close():
+    _populate()
+    srv = telemetry.serve_metrics(port=0)
+    try:
+        assert srv.port > 0
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == CONTENT_TYPE
+            body = resp.read().decode()
+        fams = metrics_scrape.parse_openmetrics(body)
+        assert metrics_scrape.sample_value(
+            fams, "serve_decode_steps", "serve_decode_steps_total") == 126
+        # scrapes are render-on-demand: a counter bump between scrapes is
+        # visible on the next one
+        telemetry.get_telemetry().inc("serve.decode_steps")
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            body2 = resp.read().decode()
+        assert "serve_decode_steps_total 127" in body2
+        # non-metrics paths 404
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url.replace("/metrics", "/nope"),
+                                   timeout=10)
+    finally:
+        srv.close()
+    # closed: the port no longer accepts scrapes
+    with pytest.raises(OSError):
+        urllib.request.urlopen(srv.url, timeout=2)
+
+
+def test_metrics_scrape_cli_assertions(tmp_path, capsys):
+    _populate()
+    p = tmp_path / "dump.txt"
+    p.write_text(render_openmetrics())
+    assert metrics_scrape.main([str(p),
+                                "--assert-family", "serve_ttft_s"]) == 0
+    out = capsys.readouterr().out
+    assert "serve_ttft_s" in out and "summary" in out
+    assert metrics_scrape.main([str(p), "--quiet",
+                                "--assert-family", "nonexistent"]) == 1
+    err = capsys.readouterr().err
+    assert "nonexistent" in err
+
+
+def test_report_tools_render_serving_sections(tmp_path, capsys):
+    """Satellite: tools/telemetry_report.py and tools/mem_report.py grow a
+    serving section — serve.* stats no longer land unhumanized in the
+    generic counter table."""
+    import mem_report
+    import telemetry_report
+
+    from paddle_tpu.utils.log_writer import LogWriter
+
+    tm = _populate()
+    with LogWriter(str(tmp_path), file_name="serve.jsonl") as w:
+        tm.export_scalars(w, step=1)
+    path = str(tmp_path / "serve.jsonl")
+
+    assert telemetry_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out
+    assert "serve.decode_steps" in out
+    assert "serve.ttft_s" in out
+    # serve stats moved OUT of the generic counter table
+    head = out.split("serving:")[0]
+    assert "serve.decode_steps" not in head
+
+    assert mem_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "serving:" in out
+    assert "serve.ttft_s" in out and "p95=" in out
+
+
+def test_telemetry_histograms_in_summary_and_report(capsys):
+    """Satellite: observe() histograms surface exact count/sum (plus
+    reservoir p50/p95) in summary() and the report() table."""
+    tm = _populate()
+    s = telemetry.summary()
+    h = s["histograms"]["serve.ttft_s"]
+    assert h["count"] == 4
+    assert h["sum"] == pytest.approx(1.0)
+    assert h["p50"] == pytest.approx(0.3)  # nearest-rank over 4 samples
+    assert h["p95"] == pytest.approx(0.4)
+    # stat() resolves any single statistic (the SLO monitor's accessor)
+    assert tm.stat("serve.ttft_s", "count") == 4
+    assert tm.stat("serve.ttft_s", "mean") == pytest.approx(0.25)
+    assert tm.stat("serve.ttft_s", "p95") == pytest.approx(0.4)
+    assert tm.stat("serve.missing", "p95") is None
+    with pytest.raises(ValueError):
+        tm.stat("serve.ttft_s", "bogus")
+    table = telemetry.report()
+    capsys.readouterr()
+    assert "histograms:" in table
+    assert "serve.ttft_s" in table

@@ -358,9 +358,61 @@ def test_scheduler_publishes_telemetry():
     assert counters["serve.evicted"] == 4
     assert counters["serve.tokens_generated"] == 16
     assert counters["serve.decode_steps"] == sched.decode_steps
-    assert gauges["serve.requests_in_flight"] == 0.0
+    # a fully-drained run() RETIRES the lifecycle gauges (stale-gauge
+    # fix) — counters/histograms survive
+    assert "serve.requests_in_flight" not in gauges
+    assert "serve.queue_depth" not in gauges
     assert ttft.get("count") == 4
     assert latency.get("count") == 4
+
+
+def test_scheduler_gauges_retired_on_drain_and_shutdown():
+    """Regression (ISSUE 8 satellite, mirrors the PR 5 DeviceLoader fix):
+    a drained or shut-down scheduler must not leave stale
+    serve.requests_in_flight / serve.queue_depth gauges behind."""
+    model = _gpt(max_pos=64)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        eng = GenerationEngine(model, max_batch=2, max_len=64,
+                               prefill_buckets=(8, 16))
+        sched = Scheduler(eng)
+        for r in _request_stream(3, 3):
+            r.max_new_tokens = 3
+            sched.submit(r)
+        tm = telemetry.get_telemetry()
+        assert tm.gauges()["serve.queue_depth"] == 3.0
+        # mid-serve (NOT drained): gauges live
+        sched.step()
+        g = tm.gauges()
+        assert g["serve.requests_in_flight"] == 2.0
+        assert g["serve.queue_depth"] == 1.0
+        # partial run that stops before the drain keeps them live too
+        sched.run(max_steps=1)
+        assert "serve.requests_in_flight" in tm.gauges()
+        # full drain retires them
+        sched.run()
+        g = tm.gauges()
+        assert "serve.requests_in_flight" not in g
+        assert "serve.queue_depth" not in g
+        # and republishing works: new traffic brings them back...
+        for r in _request_stream(5, 1):
+            r.max_new_tokens = 2
+            sched.submit(r)
+        sched.step()
+        assert "serve.requests_in_flight" in tm.gauges()
+        # ...until an explicit shutdown retires them again, mid-flight
+        sched.shutdown()
+        g = tm.gauges()
+        assert "serve.requests_in_flight" not in g
+        assert "serve.queue_depth" not in g
+        # shutdown is idempotent and only touches the lifecycle gauges
+        tm.set_gauge("serve.tokens_per_s", 42.0)
+        sched.shutdown()
+        assert tm.gauges()["serve.tokens_per_s"] == 42.0
+    finally:
+        telemetry.disable()
+        telemetry.reset()
 
 
 # ---------------------------------------------------------------------------

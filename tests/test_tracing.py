@@ -1,0 +1,359 @@
+"""Request-scoped tracing (ISSUE 8): span model, propagation through the
+serving scheduler and the hapi fit loop, compile attribution, exports.
+
+Contracts under test:
+  * zero overhead while disabled — ``span()``/``start_span()`` hand back a
+    shared no-op singleton, nothing is recorded;
+  * one exported trace reconstructs a served request END TO END: submit →
+    queue wait → prefill (with the bucket compile attributed inside it) →
+    every decode token interval → evict, all sharing the request's trace
+    id (acceptance criterion);
+  * a decode step shared by multiple slots yields exactly ONE span per
+    active request, each linked to the shared batched-dispatch span;
+  * ``Model.fit`` emits epoch/step spans under the same API, with the
+    train-step compile parented inside the first step span;
+  * the PR 2/3/6 compile-count contracts hold with tracing on: decode
+    still compiles exactly once.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.nn import CrossEntropyLoss
+from paddle_tpu.profiler import telemetry, tracing
+from paddle_tpu.serving import GenerationEngine, Request, Scheduler
+from paddle_tpu.utils import unique_name
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset()
+    yield
+    tracing.disable()
+    tracing.reset()
+
+
+@pytest.fixture
+def _clean_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _gpt(seed=0, max_pos=64):
+    with unique_name.guard():
+        paddle.seed(seed)
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=max_pos, hidden_dropout=0.0,
+            attention_dropout=0.0))
+    model.eval()
+    return model
+
+
+# ---------------------------------------------------------------------------
+# span model
+# ---------------------------------------------------------------------------
+def test_disabled_by_default_null_singletons():
+    assert not tracing.enabled()
+    s1 = tracing.span("a")
+    s2 = tracing.start_span("b")
+    assert s1 is s2 is tracing.NULL_SPAN
+    # the whole Span surface no-ops
+    with s1 as s:
+        s.set_attr("k", 1).end()
+    assert tracing.current_span() is None
+    with tracing.activate(s1):
+        pass
+    assert tracing.get_tracer().spans() == []
+    assert tracing.note_compile("step", 0, 1) is None
+
+
+def test_span_nesting_parenting_and_ids():
+    tracing.enable()
+    with tracing.span("root", attrs={"k": "v"}) as root:
+        assert tracing.current_span() is root
+        with tracing.span("child") as child:
+            with tracing.span("grandchild") as gc:
+                pass
+        with tracing.span("sibling") as sib:
+            pass
+    assert tracing.current_span() is None
+    assert child.trace_id == root.trace_id == gc.trace_id == sib.trace_id
+    assert child.parent_id == root.span_id
+    assert sib.parent_id == root.span_id
+    assert gc.parent_id == child.span_id
+    assert root.parent_id is None
+    assert root.attrs["k"] == "v"
+    # ends are monotone and every span landed in the ring
+    names = [s.name for s in tracing.get_tracer().spans()]
+    assert names == ["grandchild", "child", "sibling", "root"]
+    assert root.duration_s >= child.duration_s >= gc.duration_s >= 0
+
+
+def test_separate_roots_get_separate_traces():
+    tracing.enable()
+    with tracing.span("a") as a:
+        pass
+    with tracing.span("b") as b:
+        pass
+    assert a.trace_id != b.trace_id
+    assert set(tracing.get_tracer().trace_ids()) == {a.trace_id, b.trace_id}
+
+
+def test_manual_spans_and_activation():
+    tracing.enable()
+    tr = tracing.get_tracer()
+    root = tracing.start_span("request")
+    # not current until activated
+    assert tracing.current_span() is None
+    with tracing.activate(root):
+        assert tracing.current_span() is root
+        inner = tracing.span("work")
+        with inner as w:
+            pass
+    assert tracing.current_span() is None
+    assert w.parent_id == root.span_id
+    assert root.end_ns is None  # activation must NOT end it
+    root.end()
+    root.end()  # idempotent
+    assert len(tr.spans(root.trace_id)) == 2
+
+
+def test_ring_bound_and_dropped_counter():
+    tracing.enable(ring_size=8)
+    for i in range(20):
+        with tracing.span(f"s{i}"):
+            pass
+    tr = tracing.get_tracer()
+    assert len(tr.spans()) == 8
+    assert tr.dropped == 12
+    tracing.enable(ring_size=8192)  # restore the default for later tests
+
+
+def test_export_jsonl_and_chrome(tmp_path):
+    tracing.enable()
+    with tracing.span("outer", attrs={"rid": 7}):
+        with tracing.span("inner"):
+            pass
+    p = tmp_path / "trace.jsonl"
+    n = tracing.get_tracer().export_jsonl(str(p))
+    rows = [json.loads(l) for l in p.read_text().splitlines()]
+    assert n == len(rows) == 2
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["inner"]["parent"] == by_name["outer"]["span"]
+    assert by_name["inner"]["trace"] == by_name["outer"]["trace"]
+    assert by_name["outer"]["attrs"]["rid"] == 7
+    assert all(r["end_ns"] >= r["start_ns"] for r in rows)
+
+    cp = tmp_path / "trace_chrome.json"
+    ne = tracing.get_tracer().export_chrome(str(cp))
+    doc = json.loads(cp.read_text())
+    assert ne == 2
+    evs = doc["traceEvents"]
+    assert all(e["ph"] == "X" for e in evs)
+    assert {e["name"] for e in evs} == {"outer", "inner"}
+    assert evs[0]["ts"] <= evs[1]["ts"]
+
+
+def test_export_chrome_merges_telemetry(tmp_path, _clean_telemetry):
+    telemetry.enable()
+    tracing.enable()
+    with telemetry.phase_span("dispatch"):
+        pass
+    with tracing.span("req"):
+        pass
+    cp = tmp_path / "merged.json"
+    n = tracing.get_tracer().export_chrome(str(cp), include_telemetry=True)
+    evs = json.loads(cp.read_text())["traceEvents"]
+    assert n == len(evs) == 2
+    assert {e["name"] for e in evs} == {"req", "telemetry::dispatch"}
+
+
+# ---------------------------------------------------------------------------
+# serving: the end-to-end request reconstruction (acceptance criterion)
+# ---------------------------------------------------------------------------
+def _serve(n_requests=3, max_batch=2, max_new=4, slo=None):
+    model = _gpt()
+    eng = GenerationEngine(model, max_batch=max_batch, max_len=64,
+                           prefill_buckets=(8, 16))
+    sched = Scheduler(eng, slo=slo)
+    rng = np.random.RandomState(0)
+    reqs = [Request(prompt=rng.randint(0, 97, 5).tolist(),
+                    max_new_tokens=max_new) for _ in range(n_requests)]
+    for r in reqs:
+        sched.submit(r)
+    sched.run()
+    sched.shutdown()  # closes the serve_session span
+    return eng, sched, reqs
+
+
+def test_request_trace_reconstructs_end_to_end(tmp_path, _clean_telemetry):
+    telemetry.enable()
+    tracing.enable()
+    eng, sched, reqs = _serve(n_requests=3, max_batch=2, max_new=4)
+    tr = tracing.get_tracer()
+
+    for req in reqs:
+        assert req.trace_id is not None
+        spans = {s.span_id: s for s in tr.spans(req.trace_id)}
+        by_name = {}
+        for s in spans.values():
+            by_name.setdefault(s.name, []).append(s)
+        root = by_name["request"][0]
+        queue = by_name["queue"][0]
+        prefill = by_name["prefill"][0]
+        decodes = sorted(by_name["decode_token"],
+                         key=lambda s: s.attrs["index"])
+
+        # all spans share the request's trace and hang off its root
+        assert root.parent_id is None
+        assert queue.parent_id == root.span_id
+        assert prefill.parent_id == root.span_id
+        assert all(d.parent_id == root.span_id for d in decodes)
+
+        # the life cycle is ordered: submit → queue wait → prefill →
+        # every decode token interval → evict
+        assert root.start_ns <= queue.start_ns <= queue.end_ns
+        assert queue.end_ns <= prefill.start_ns <= prefill.end_ns
+        prev = prefill.end_ns
+        for d in decodes:
+            assert d.start_ns >= prev - 1  # shared batched interval
+            prev = d.end_ns
+        assert root.end_ns >= prev
+
+        # token accounting: prefill's token + one decode span per
+        # subsequent token
+        assert len(decodes) == len(req.tokens) - 1
+        assert [d.attrs["token"] for d in decodes] == req.tokens[1:]
+        assert root.attrs["finish_reason"] == req.finish_reason
+        assert root.attrs["ttft_s"] == pytest.approx(req.ttft_s)
+        assert root.attrs["latency_s"] == pytest.approx(req.latency_s)
+
+        # the engine's serve_prefill span nests inside the scheduler's
+        # prefill span — same trace, so compile attribution joins up
+        engine_pf = by_name["serve_prefill"][0]
+        assert engine_pf.parent_id == prefill.span_id
+
+    # compile attribution: the FIRST request through a cold bucket carries
+    # the serve_prefill compile span inside its own trace
+    first = reqs[0]
+    comp = [s for s in tr.spans(first.trace_id) if s.name == "compile"]
+    assert comp, "no compile span attributed to the first request"
+    assert comp[0].attrs["step"] == "serve_prefill"
+    assert comp[0].attrs["compile_index"] == 1
+
+    # JSONL export round-trips the whole reconstruction
+    p = tmp_path / "req.jsonl"
+    tr.export_jsonl(str(p), trace_id=first.trace_id)
+    rows = [json.loads(l) for l in p.read_text().splitlines()]
+    assert {r["trace"] for r in rows} == {first.trace_id}
+    assert {"request", "queue", "prefill", "decode_token",
+            "compile"} <= {r["name"] for r in rows}
+
+    # PR 6 contract unchanged under tracing: decode compiled EXACTLY once
+    assert telemetry.get_telemetry().compile_counts()["serve_decode"] == 1
+
+
+def test_shared_decode_step_one_span_per_active_request(_clean_telemetry):
+    """Two requests decoding in the same batched step: each gets its OWN
+    decode_token span over the shared interval, linked to the shared
+    decode_step span."""
+    telemetry.enable()
+    tracing.enable()
+    eng, sched, reqs = _serve(n_requests=2, max_batch=2, max_new=4)
+    tr = tracing.get_tracer()
+
+    session = [s for s in tr.spans() if s.name == "serve_session"]
+    shared = [s for s in tr.spans() if s.name == "decode_step"]
+    assert session and shared
+    assert all(s.parent_id == session[0].span_id for s in shared)
+    # both requests were admitted in tick 0, so every decode_step ran 2
+    # slots: per shared span, exactly one decode_token per request
+    for ds in shared:
+        linked = [s for s in tr.spans()
+                  if s.name == "decode_token"
+                  and s.attrs.get("decode_span") == ds.span_id]
+        assert len(linked) == ds.attrs["active"] == 2
+        assert ({s.trace_id for s in linked}
+                == {r.trace_id for r in reqs})
+        # the fan-out reuses the shared dispatch interval verbatim
+        assert all(s.start_ns == ds.start_ns and s.end_ns == ds.end_ns
+                   for s in linked)
+
+
+def test_scheduler_tracing_off_is_free(_clean_telemetry):
+    """Tracing disabled: no Request picks up spans and the tracer stays
+    empty — the serving loop's disabled path does zero tracing work."""
+    telemetry.enable()
+    eng, sched, reqs = _serve(n_requests=2, max_batch=2, max_new=3)
+    assert all(r.trace_span is None and r.trace_id is None for r in reqs)
+    assert tracing.get_tracer().spans() == []
+
+
+def test_generate_emits_its_own_trace():
+    tracing.enable()
+    model = _gpt()
+    eng = GenerationEngine(model, max_batch=1, max_len=64,
+                           prefill_buckets=(8,))
+    out = eng.generate([1, 2, 3], max_new_tokens=3)
+    tr = tracing.get_tracer()
+    gen = [s for s in tr.spans() if s.name == "generate"]
+    assert len(gen) == 1
+    inside = tr.spans(gen[0].trace_id)
+    names = [s.name for s in inside]
+    assert names.count("serve_prefill") == 1
+    assert names.count("serve_decode") == len(out) - 1
+
+
+# ---------------------------------------------------------------------------
+# training: Model.fit under the same span model
+# ---------------------------------------------------------------------------
+class _ToyDS:
+    def __init__(self, n=48):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype(np.float32)
+        w = rng.randn(8).astype(np.float32)
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_model_fit_emits_step_spans(_clean_telemetry):
+    tracing.enable()
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 2))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    model.prepare(opt, CrossEntropyLoss())
+    model.fit(_ToyDS(), batch_size=16, epochs=1, verbose=0)
+
+    tr = tracing.get_tracer()
+    epochs = [s for s in tr.spans() if s.name == "train_epoch"]
+    steps = [s for s in tr.spans() if s.name == "train_step"]
+    assert len(epochs) == 1
+    assert len(steps) == 3  # 48 samples / batch 16
+    root = epochs[0]
+    assert all(s.parent_id == root.span_id for s in steps)
+    assert all(s.trace_id == root.trace_id for s in steps)
+    assert [s.attrs["step"] for s in
+            sorted(steps, key=lambda s: s.start_ns)] == [0, 1, 2]
+    assert root.attrs["samples"] == 48
+    # the train-step compile is attributed inside the first step span —
+    # even though telemetry was off (tracing-only compile attribution)
+    comps = [s for s in tr.spans(root.trace_id) if s.name == "compile"]
+    assert comps, "train-step compile not attributed to the trace"
+    first_step = min(steps, key=lambda s: s.start_ns)
+    assert comps[0].parent_id == first_step.span_id
+    # telemetry stayed untouched: tracing alone must not populate it
+    assert telemetry.get_telemetry().counters() == {}

@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""Bench-history regression sentinel over the checked-in round artifacts.
+
+Five rounds of BENCH/SERVE/MULTICHIP evidence sit in the repo and nothing
+machine-checks them — throughput went flat for two rounds and only a human
+noticed. This tool loads every ``BENCH_r*.json`` / ``SERVE_r*.json`` /
+``MULTICHIP_r*.json`` series, extracts the headline metrics per round
+(tokens/sec, MFU, comm_fraction, p95 latency/TTFT, decode compile counts,
+dryrun parity), and compares the NEWEST round against a trailing baseline:
+
+* baseline = median of up to ``--window`` prior rounds carrying the metric
+  (median, not mean: one outlier round must not move the bar);
+* tolerance = ``max(--rel-tol, --noise-k × noise)`` where noise is the
+  robust coefficient of variation (1.4826·MAD/|median|) of the baseline
+  window, capped at ``--noise-cap`` — a historically jittery metric gets
+  slack, a historically flat one is held tight;
+* direction-aware: tokens/sec and MFU regress DOWN, latency and compile
+  counts regress UP, booleans (dryrun ok) regress on any flip.
+
+Exits nonzero with a ranked table on regression — wired into
+``tools/run_tests.sh`` (``--smoke``) so every future PR's bench round is
+checked mechanically. ``--smoke`` both (a) runs the real history, which
+must be clean, and (b) self-tests detection by injecting a synthetic 20%
+tokens/sec drop as a new round, which MUST be flagged.
+
+Usage::
+
+    python tools/bench_sentinel.py                 # check repo history
+    python tools/bench_sentinel.py --smoke         # CI gate
+    python tools/bench_sentinel.py --inject bench:tokens_per_sec=0.8
+
+Stdlib-only on purpose (CI runs it without jax), like the other tools/
+report CLIs.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+#: robust-noise cap: never let a wild history widen tolerance past this
+DEFAULT_NOISE_CAP = 0.20
+DEFAULT_REL_TOL = 0.08
+DEFAULT_WINDOW = 3
+
+
+def _get(d, *path):
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return None
+        d = d[p]
+    return d
+
+
+def extract_bench(doc):
+    """BENCH rounds: training throughput + MFU (+ device stats when the
+    telemetry block carries them)."""
+    out = {}
+    v = _get(doc, "parsed", "value")
+    if isinstance(v, (int, float)):
+        out["tokens_per_sec"] = (float(v), "higher")
+    mfu = _get(doc, "parsed", "mfu")
+    if isinstance(mfu, (int, float)):
+        out["mfu"] = (float(mfu), "higher")
+    for path, name, direction in (
+            (("telemetry", "comm_fraction"), "comm_fraction", "lower"),
+            (("parsed", "comm_fraction"), "comm_fraction", "lower"),
+            (("telemetry", "recompile_count"), "recompile_count", "lower")):
+        v = _get(doc, *path)
+        if isinstance(v, (int, float)) and name not in out:
+            out[name] = (float(v), direction)
+    return out
+
+
+def extract_serve(doc):
+    """SERVE rounds: serving throughput, tail latency/TTFT, batching
+    speedup, and the O(1)-decode compile contract."""
+    out = {}
+    v = doc.get("value")
+    if isinstance(v, (int, float)):
+        out["tokens_per_sec"] = (float(v), "higher")
+    for path, name, direction in (
+            (("continuous", "p95_latency_s"), "p95_latency_s", "lower"),
+            (("continuous", "p95_ttft_s"), "p95_ttft_s", "lower"),
+            (("speedup_vs_sequential",), "speedup_vs_sequential", "higher"),
+            (("telemetry", "compiles", "serve_decode"),
+             "decode_compiles", "equal"),
+            (("decode_lint", "shape_churn_findings"),
+             "shape_churn_findings", "lower")):
+        v = _get(doc, *path)
+        if isinstance(v, (int, float)):
+            out[name] = (float(v), direction)
+    return out
+
+
+def extract_multichip(doc):
+    """MULTICHIP rounds: the dryrun must keep passing at the same scale."""
+    out = {}
+    ok = doc.get("ok")
+    if isinstance(ok, bool):
+        out["dryrun_ok"] = (1.0 if ok else 0.0, "equal")
+    n = doc.get("n_devices")
+    if isinstance(n, (int, float)):
+        out["n_devices"] = (float(n), "equal")
+    return out
+
+
+SERIES = (
+    ("bench", "BENCH_r*.json", extract_bench),
+    ("serve", "SERVE_r*.json", extract_serve),
+    ("multichip", "MULTICHIP_r*.json", extract_multichip),
+)
+
+
+def load_series(root):
+    """→ {series: [(round, {metric: (value, direction)}), ...]} sorted by
+    round number; rounds that fail to parse are skipped with a note."""
+    out = {}
+    for name, pattern, extract in SERIES:
+        rounds = []
+        for path in glob.glob(os.path.join(root, pattern)):
+            m = _ROUND_RE.search(os.path.basename(path))
+            if m is None:
+                continue
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"note: skipping unreadable {path}: {e}",
+                      file=sys.stderr)
+                continue
+            metrics = extract(doc)
+            if metrics:
+                rounds.append((int(m.group(1)), metrics))
+        rounds.sort()
+        if rounds:
+            out[name] = rounds
+    return out
+
+
+def _robust_noise(values):
+    """1.4826·MAD / |median| — the robust coefficient of variation. 0.0
+    when fewer than 3 points (no spread estimate worth trusting)."""
+    if len(values) < 3:
+        return 0.0
+    med = statistics.median(values)
+    if med == 0:
+        return 0.0
+    mad = statistics.median(abs(v - med) for v in values)
+    return 1.4826 * mad / abs(med)
+
+
+def compare(series, window=DEFAULT_WINDOW, rel_tol=DEFAULT_REL_TOL,
+            noise_k=1.0, noise_cap=DEFAULT_NOISE_CAP):
+    """Compare each series' newest round against its trailing baseline.
+    → list of finding dicts (every metric gets one, regression or not)."""
+    findings = []
+    for name, rounds in series.items():
+        newest_round, newest = rounds[-1]
+        for metric, (value, direction) in sorted(newest.items()):
+            prior = [(r, m[metric][0]) for r, m in rounds[:-1]
+                     if metric in m]
+            f = {
+                "series": name,
+                "metric": metric,
+                "round": newest_round,
+                "value": value,
+                "direction": direction,
+                "baseline": None,
+                "baseline_rounds": [r for r, _ in prior[-window:]],
+                "tolerance": None,
+                "delta": None,
+                "severity": 0.0,
+                "status": "no-history",
+            }
+            if prior:
+                base_vals = [v for _, v in prior[-window:]]
+                baseline = statistics.median(base_vals)
+                noise = min(_robust_noise(base_vals), noise_cap)
+                tol = max(rel_tol, noise_k * noise)
+                f["baseline"] = baseline
+                f["tolerance"] = tol
+                if baseline != 0:
+                    f["delta"] = value / baseline - 1.0
+                else:
+                    f["delta"] = 0.0 if value == 0 else float("inf")
+                regressed = False
+                if direction == "higher":
+                    regressed = value < baseline * (1.0 - tol)
+                elif direction == "lower":
+                    if baseline == 0:
+                        # a metric that has been 0 (lint findings, give-
+                        # ups) regresses on ANY appearance
+                        regressed = value > 0
+                    else:
+                        regressed = value > baseline * (1.0 + tol)
+                else:  # equal: contract metrics (compile counts, dryrun ok)
+                    regressed = value != baseline
+                if regressed:
+                    f["status"] = "REGRESSION"
+                    over = abs(f["delta"]) if f["delta"] not in (None,) \
+                        else 1.0
+                    f["severity"] = (over / tol) if tol else float("inf")
+                else:
+                    f["status"] = "ok"
+            findings.append(f)
+    findings.sort(key=lambda f: (-f["severity"], f["series"], f["metric"]))
+    return findings
+
+
+def build_table(findings, verbose=False):
+    rows = [f for f in findings
+            if verbose or f["status"] == "REGRESSION"] or findings
+    lines = [f"{'status':<11} {'series':<10} {'metric':<24} {'round':>5} "
+             f"{'value':>12} {'baseline':>12} {'delta':>8} {'tol':>7}"]
+    lines.append("-" * 96)
+    for f in rows:
+        base = "-" if f["baseline"] is None else f"{f['baseline']:g}"
+        delta = "-" if f["delta"] is None else f"{100 * f['delta']:+.1f}%"
+        tol = "-" if f["tolerance"] is None else f"{100 * f['tolerance']:.0f}%"
+        lines.append(f"{f['status']:<11} {f['series']:<10} "
+                     f"{f['metric']:<24} {f['round']:>5} {f['value']:>12g} "
+                     f"{base:>12} {delta:>8} {tol:>7}")
+    return "\n".join(lines)
+
+
+def _parse_inject(spec):
+    """``series:metric=factor`` → (series, metric, factor)."""
+    m = re.match(r"^(\w+):([\w.]+)=([-+0-9.eE]+)$", spec)
+    if m is None:
+        raise ValueError(f"bad --inject spec {spec!r} "
+                         f"(want series:metric=factor)")
+    return m.group(1), m.group(2), float(m.group(3))
+
+
+def inject_round(series, target, metric, factor):
+    """Append a synthetic next round scaling ``metric`` by ``factor``
+    (other metrics copied forward) — the detection self-test."""
+    if target not in series or not series[target]:
+        raise ValueError(f"no history for series {target!r}")
+    rounds = series[target]
+    last_round, last = rounds[-1]
+    if metric not in last:
+        raise ValueError(f"metric {metric!r} absent from {target} "
+                         f"round {last_round}")
+    synth = {k: (v * factor if k == metric else v, d)
+             for k, (v, d) in last.items()}
+    series = dict(series)
+    series[target] = rounds + [(last_round + 1, synth)]
+    return series
+
+
+def run_check(series, args, label=""):
+    findings = compare(series, window=args.window, rel_tol=args.rel_tol,
+                       noise_k=args.noise_k, noise_cap=args.noise_cap)
+    regressions = [f for f in findings if f["status"] == "REGRESSION"]
+    tag = f" [{label}]" if label else ""
+    print(f"bench sentinel{tag}: {len(findings)} metrics across "
+          f"{len(series)} series — {len(regressions)} regression(s)")
+    print(build_table(findings, verbose=args.verbose))
+    return findings, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="directory holding the *_r*.json history "
+                         "(default: the repo root above tools/)")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing rounds in the baseline median")
+    ap.add_argument("--rel-tol", type=float, default=DEFAULT_REL_TOL,
+                    help="minimum relative tolerance before flagging")
+    ap.add_argument("--noise-k", type=float, default=1.0,
+                    help="multiplier on the robust history noise")
+    ap.add_argument("--noise-cap", type=float, default=DEFAULT_NOISE_CAP,
+                    help="upper bound on the noise term")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="SERIES:METRIC=FACTOR",
+                    help="append a synthetic round with METRIC scaled by "
+                         "FACTOR (detection self-test); repeatable")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: real history must be clean AND an "
+                         "injected 20%% tokens/sec drop must be flagged")
+    ap.add_argument("--json", default=None,
+                    help="also dump the findings to this JSON file")
+    ap.add_argument("--verbose", action="store_true",
+                    help="list non-regressed metrics too")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    series = load_series(root)
+    if not series:
+        print(f"no *_r*.json bench history under {root}", file=sys.stderr)
+        return 2
+
+    for spec in args.inject:
+        series = inject_round(series, *_parse_inject(spec))
+
+    findings, regressions = run_check(series, args)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(findings, f, indent=1)
+            f.write("\n")
+
+    if args.smoke:
+        if regressions:
+            print("SMOKE FAIL: checked-in history flagged as regressed",
+                  file=sys.stderr)
+            return 1
+        # detection self-test: a 20% tokens/sec drop on every series that
+        # carries the metric MUST be flagged
+        tested = 0
+        for name in series:
+            if "tokens_per_sec" not in series[name][-1][1]:
+                continue
+            if len(series[name]) < 2:
+                continue  # single-round series can't regress yet
+            tested += 1
+            injected = inject_round(series, name, "tokens_per_sec", 0.8)
+            _, regs = run_check(injected, args, label=f"inject {name} -20%")
+            if not any(r["metric"] == "tokens_per_sec"
+                       and r["series"] == name for r in regs):
+                print(f"SMOKE FAIL: injected 20% {name} tokens/sec drop "
+                      f"was NOT flagged", file=sys.stderr)
+                return 1
+        if not tested:
+            print("SMOKE FAIL: no multi-round tokens/sec series to "
+                  "self-test against", file=sys.stderr)
+            return 1
+        print(f"SMOKE OK: history clean; injected-drop detection verified "
+              f"on {tested} series")
+        return 0
+
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,7 +135,10 @@ def run_continuous(model, requests, max_len, buckets, concurrency):
         "p95_ttft_s": round(_pctl(ttft, 95), 4),
         "batch_occupancy": round(sched.occupancy(), 4),
         "decode_steps": sched.decode_steps,
-        "requests_in_flight": tm.gauges().get("serve.requests_in_flight"),
+        # the drain retires the in-flight gauges (stale-gauge fix); a
+        # fully-drained run reports 0 by construction
+        "requests_in_flight": tm.gauges().get("serve.requests_in_flight",
+                                              0.0),
     }
     # publish the bench headline back into the registry so the telemetry
     # block (and anything tailing the exporter) carries it
@@ -174,10 +177,16 @@ def telemetry_serve_block():
                   if k.startswith("serve.")})
     block["compiles"] = dict(s["compiles"])
     block["recompile_count"] = int(s["recompile_count"])
+    tm = telemetry.get_telemetry()
     for name in ("serve.ttft_s", "serve.tpot_s", "serve.latency_s"):
-        st = telemetry.get_telemetry().get(name)
+        st = tm.get(name)
         if st and st.get("count"):
             block[name + ".mean"] = round(st["sum"] / st["count"], 6)
+            # exact running sum plus the reservoir percentiles (the
+            # sentinel and scrapers want rate-correct figures)
+            block[name + ".sum"] = round(st["sum"], 6)
+            block[name + ".p50"] = round(tm.stat(name, "p50"), 6)
+            block[name + ".p95"] = round(tm.stat(name, "p95"), 6)
     return block
 
 

@@ -13,7 +13,9 @@ device-side ground truth:
   ``comm.count.<axis>``), ranked by bytes, plus the comm-vs-compute
   fraction;
 * compiled cost figures (FLOPs, bytes accessed) and pipeline-schedule
-  metrics when present.
+  metrics when present;
+* the serving tier (``serve.*`` gauges/counters and latency/TTFT
+  histograms) when the run served requests.
 
 Usage::
 
@@ -78,7 +80,21 @@ def collect(records):
     comm_count = {t[len("telemetry/counter/comm.count."):]: v
                   for t, v in last.items()
                   if t.startswith("telemetry/counter/comm.count.")}
-    return hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count
+    # serving tier: serve.* gauges/counters plus the latency histograms
+    # (telemetry/hist/serve.<name>/<field>)
+    serve = {}
+    for prefix, kind in (("telemetry/gauge/serve.", "gauge"),
+                         ("telemetry/counter/serve.", "counter")):
+        for t, v in last.items():
+            if t.startswith(prefix):
+                serve[t[len(prefix):]] = v
+    serve_hists = {}
+    for t, v in last.items():
+        if t.startswith("telemetry/hist/serve."):
+            name, _, field = t[len("telemetry/hist/serve."):].rpartition("/")
+            serve_hists.setdefault(name, {})[field] = v
+    return hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count, \
+        serve, serve_hists
 
 
 def human_bytes(n):
@@ -89,7 +105,8 @@ def human_bytes(n):
         n /= 1024.0
 
 
-def build_report(hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count):
+def build_report(hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count,
+                 serve=None, serve_hists=None):
     lines = []
     if hbm:
         peak = hbm.get("peak_bytes") or 1.0
@@ -132,6 +149,20 @@ def build_report(hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count):
         lines.append("pipeline schedule:")
         for k in sorted(pipeline):
             lines.append(f"  {k:<24} {pipeline[k]:g}")
+    if serve or serve_hists:
+        lines.append("serving:")
+        for k in sorted(serve or {}):
+            v = serve[k]
+            lines.append(f"  serve.{k:<24} "
+                         f"{int(v) if v == int(v) else round(v, 6):g}")
+        for name in sorted(serve_hists or {}):
+            h = serve_hists[name]
+            count = int(h.get("count", 0))
+            lines.append(
+                f"  serve.{name:<24} n={count} "
+                f"mean={h.get('mean', 0.0) * 1e3:.1f}ms "
+                f"p50={h.get('p50', 0.0) * 1e3:.1f}ms "
+                f"p95={h.get('p95', 0.0) * 1e3:.1f}ms")
     return "\n".join(lines)
 
 

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Scrape and parse an OpenMetrics ``/metrics`` endpoint (round-trip check).
+
+The parsing half of ``paddle_tpu/profiler/export.py``: fetch the exposition
+text (HTTP URL or a local file), parse it into metric families, and render
+a table. ``--assert-family`` makes it a CI gate — exit 1 unless every named
+family was scraped (tools/run_tests.sh asserts the ``serve_*``/``step_*``
+families survive the render→HTTP→parse round trip).
+
+Usage::
+
+    python tools/metrics_scrape.py http://127.0.0.1:9464/metrics
+    python tools/metrics_scrape.py dump.txt --assert-family serve_ttft_s
+
+Stdlib-only on purpose: a fleet monitor sidecar (or CI) must be able to
+scrape without importing jax — mirrors tools/telemetry_report.py.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>[^}]*)\})?"
+    r"\s+(?P<value>[^\s]+)"
+    r"(\s+(?P<ts>[^\s]+))?\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: sample-name suffixes that belong to a parent summary/counter family
+_SUFFIXES = ("_total", "_count", "_sum", "_bucket", "_created")
+
+
+def _family_of(sample_name, types):
+    """Map a sample name back to its family (``x_total`` → ``x`` when
+    ``x`` was TYPEd)."""
+    if sample_name in types:
+        return sample_name
+    for suf in _SUFFIXES:
+        if sample_name.endswith(suf) and sample_name[: -len(suf)] in types:
+            return sample_name[: -len(suf)]
+    return sample_name
+
+
+def parse_openmetrics(text):
+    """Parse exposition text → ``{family: {"type", "help", "samples"}}``
+    where samples is a list of ``(sample_name, labels_dict, value)``.
+    Raises ``ValueError`` on an unparseable sample line or a missing
+    ``# EOF`` terminator (a truncated scrape must not pass silently)."""
+    families = {}
+    types = {}
+    saw_eof = False
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line == "# EOF":
+            saw_eof = True
+            continue
+        if saw_eof:
+            raise ValueError(f"line {lineno}: content after # EOF")
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+                families.setdefault(parts[2], {"type": parts[3],
+                                               "help": None, "samples": []})
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                fam = families.setdefault(
+                    parts[2], {"type": None, "help": None, "samples": []})
+                fam["help"] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        labels = {}
+        if m.group("labels"):
+            for k, v in _LABEL_RE.findall(m.group("labels")):
+                labels[k] = v.replace('\\"', '"').replace("\\\\", "\\")
+        name = m.group("name")
+        fam = families.setdefault(
+            _family_of(name, types), {"type": None, "help": None,
+                                      "samples": []})
+        fam["samples"].append((name, labels, float(m.group("value"))))
+    if not saw_eof:
+        raise ValueError("exposition not terminated by # EOF")
+    return families
+
+
+def sample_value(families, family, sample_name=None, **labels):
+    """Convenience lookup: the first sample of ``family`` matching the
+    sample name (default: the family name itself) and label subset."""
+    fam = families.get(family)
+    if fam is None:
+        return None
+    want = sample_name or family
+    for name, lbls, value in fam["samples"]:
+        if name == want and all(lbls.get(k) == v for k, v in labels.items()):
+            return value
+    return None
+
+
+def fetch(target, timeout=10.0):
+    """Read exposition text from an http(s) URL or a local file path."""
+    if target.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+
+        with urlopen(target, timeout=timeout) as resp:
+            return resp.read().decode("utf-8")
+    with open(target) as f:
+        return f.read()
+
+
+def build_table(families):
+    lines = [f"{'family':<36} {'type':<9} {'samples':>8} {'value':>16}"]
+    lines.append("-" * 72)
+    for fam in sorted(families):
+        f = families[fam]
+        head = ""
+        if f["samples"]:
+            name, labels, value = f["samples"][0]
+            lbl = ",".join(f"{k}={v}" for k, v in labels.items())
+            head = f"{value:g}" + (f" [{name}{{{lbl}}}]" if labels else "")
+        lines.append(f"{fam:<36} {f['type'] or '?':<9} "
+                     f"{len(f['samples']):>8} {head:>16}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", help="http(s)://host:port/metrics URL or a "
+                                   "file of exposition text")
+    ap.add_argument("--assert-family", action="append", default=[],
+                    metavar="NAME",
+                    help="fail (exit 1) unless this family was scraped "
+                         "with at least one sample; repeatable")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the table (assertions still run)")
+    args = ap.parse_args(argv)
+
+    try:
+        text = fetch(args.target)
+        families = parse_openmetrics(text)
+    except Exception as e:  # noqa: BLE001 - CLI surface
+        print(f"scrape failed: {e}", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"scraped {len(families)} families from {args.target}")
+        print(build_table(families))
+    missing = [n for n in args.assert_family
+               if not families.get(n, {}).get("samples")]
+    if missing:
+        print(f"missing families: {', '.join(missing)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,55 @@ PYEOF
   python tools/ckpt_doctor.py verify "$SMOKE_DIR/ckpt" --step 1
   python tools/ckpt_doctor.py inspect "$SMOKE_DIR/ckpt" --step 1
   python tools/ckpt_doctor.py prune "$SMOKE_DIR/ckpt" --keep 1 --dry-run
+  # /metrics scrape round-trip (ISSUE 8): populate the registry with
+  # serve.*/step.* families, stand the OpenMetrics endpoint up on an
+  # ephemeral port, scrape it over HTTP with the stdlib parser, and
+  # assert the known families (incl. histogram _count/_sum via the
+  # summary family) survived the render→serve→parse round trip
+  JAX_PLATFORMS=cpu python - <<'PYEOF'
+import sys, time
+sys.path.insert(0, "tools")
+import metrics_scrape
+from paddle_tpu.profiler import telemetry
+
+telemetry.reset()
+telemetry.enable()
+tm = telemetry.get_telemetry()
+telemetry.step_begin()
+for phase in telemetry.PHASES:
+    with telemetry.phase_span(phase):
+        time.sleep(0.001)
+telemetry.step_end()
+tm.inc("serve.decode_steps", 7)
+tm.set_gauge("serve.queue_depth", 3)
+for v in (0.05, 0.1, 0.2):
+    tm.observe("serve.ttft_s", v)
+srv = telemetry.serve_metrics(port=0)
+try:
+    rc = metrics_scrape.main([
+        srv.url,
+        "--assert-family", "serve_decode_steps",
+        "--assert-family", "serve_queue_depth",
+        "--assert-family", "serve_ttft_s",
+        "--assert-family", "step_time_s",
+        "--assert-family", "phase_dispatch",
+    ])
+    assert rc == 0, "metrics scrape round trip failed"
+    fams = metrics_scrape.parse_openmetrics(metrics_scrape.fetch(srv.url))
+    count = metrics_scrape.sample_value(fams, "serve_ttft_s",
+                                        "serve_ttft_s_count")
+    total = metrics_scrape.sample_value(fams, "serve_ttft_s",
+                                        "serve_ttft_s_sum")
+    assert count == 3 and abs(total - 0.35) < 1e-9, (count, total)
+finally:
+    srv.close()
+    telemetry.disable()
+    telemetry.reset()
+PYEOF
+  # bench-history regression sentinel (ISSUE 8): the checked-in
+  # BENCH/SERVE/MULTICHIP rounds must pass the noise-aware baseline
+  # check, and an injected 20% tokens/sec drop MUST be flagged
+  python tools/bench_sentinel.py --smoke
   rm -rf "$SMOKE_DIR"
 fi
 

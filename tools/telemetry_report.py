@@ -27,9 +27,17 @@ PHASE_ORDER = ("data_wait", "h2d_copy", "compile", "dispatch", "readback")
 #: Telemetry.DEVICE_PREFIXES)
 DEVICE_PREFIXES = ("hbm.", "comm.", "cost.", "pipeline.", "oom.")
 
+#: serving-tier scalars (scheduler/engine) rendered in their own
+#: humanized section instead of the generic counter table
+SERVE_PREFIX = "serve."
+
 
 def _is_device_stat(name):
     return any(name.startswith(p) for p in DEVICE_PREFIXES)
+
+
+def _is_serve_stat(name):
+    return name.startswith(SERVE_PREFIX)
 
 
 def _human_bytes(n):
@@ -81,18 +89,37 @@ def collect(records):
         elif tag.startswith("telemetry/"):
             last[tag] = float(value)
     phases = {}
+    hists = {}
     for tag, value in last.items():
         if tag.startswith("telemetry/phase/"):
             name, _, field = tag[len("telemetry/phase/"):].rpartition("/")
             phases.setdefault(name, {})[field] = value
+        elif tag.startswith("telemetry/hist/"):
+            name, _, field = tag[len("telemetry/hist/"):].rpartition("/")
+            hists.setdefault(name, {})[field] = value
     counters = {t[len("telemetry/counter/"):]: v for t, v in last.items()
                 if t.startswith("telemetry/counter/")}
     gauges = {t[len("telemetry/gauge/"):]: v for t, v in last.items()
               if t.startswith("telemetry/gauge/")}
-    return phases, steps, counters, gauges
+    return phases, steps, counters, gauges, hists
 
 
-def build_table(phases, steps, counters, gauges):
+def _hist_rows(hists, lines, indent="  "):
+    """Histogram rows: exact count/sum plus the reservoir percentiles."""
+    lines.append(f"{indent}{'histogram':<23} {'Count':>7} {'Sum':>11} "
+                 f"{'Mean':>10} {'P50':>10} {'P95':>10}")
+    for name in sorted(hists):
+        h = hists[name]
+        count = int(h.get("count", 0))
+        total = h.get("sum", 0.0)
+        mean = h.get("mean", total / count if count else 0.0)
+        lines.append(f"{indent}{name:<23} {count:>7} {total:>11.4f} "
+                     f"{mean:>10.4f} {h.get('p50', 0.0):>10.4f} "
+                     f"{h.get('p95', 0.0):>10.4f}")
+
+
+def build_table(phases, steps, counters, gauges, hists=None):
+    hists = hists or {}
     has_pct = any("p50_s" in p or "p95_s" in p for p in phases.values())
     head = f"{'Phase':<12} {'Count':>8} {'Total(s)':>12} {'Mean(ms)':>12} "
     if has_pct:
@@ -125,10 +152,15 @@ def build_table(phases, steps, counters, gauges):
             lines.append(f"  {name:<19} {s['count']:>6} {mean * 1e3:>12.3f} "
                          f"{s['max'] * 1e3:>12.3f}")
     plain_counters = {k: v for k, v in counters.items()
-                      if not _is_device_stat(k)}
+                      if not _is_device_stat(k) and not _is_serve_stat(k)}
     dev_counters = {k: v for k, v in counters.items() if _is_device_stat(k)}
-    plain_gauges = {k: v for k, v in gauges.items() if not _is_device_stat(k)}
+    serve_counters = {k: v for k, v in counters.items() if _is_serve_stat(k)}
+    plain_gauges = {k: v for k, v in gauges.items()
+                    if not _is_device_stat(k) and not _is_serve_stat(k)}
     dev_gauges = {k: v for k, v in gauges.items() if _is_device_stat(k)}
+    serve_gauges = {k: v for k, v in gauges.items() if _is_serve_stat(k)}
+    serve_hists = {k: v for k, v in hists.items() if _is_serve_stat(k)}
+    plain_hists = {k: v for k, v in hists.items() if not _is_serve_stat(k)}
     if plain_counters:
         lines.append("counters:")
         for k in sorted(plain_counters):
@@ -138,6 +170,20 @@ def build_table(phases, steps, counters, gauges):
         lines.append("gauges:")
         for k in sorted(plain_gauges):
             lines.append(f"  {k:<38} {plain_gauges[k]:g}")
+    if plain_hists:
+        lines.append("histograms:")
+        _hist_rows(plain_hists, lines)
+    if serve_counters or serve_gauges or serve_hists:
+        # serving tier (scheduler/engine): request lifecycle counters,
+        # in-flight gauges and the latency/TTFT histograms in one place
+        lines.append("serving:")
+        for k in sorted(serve_gauges):
+            lines.append(f"  {k:<38} {serve_gauges[k]:g}")
+        for k in sorted(serve_counters):
+            v = serve_counters[k]
+            lines.append(f"  {k:<38} {int(v) if v == int(v) else v}")
+        if serve_hists:
+            _hist_rows(serve_hists, lines)
     if dev_gauges or dev_counters:
         # devprof harvest: HBM breakdown, per-axis collective bytes,
         # pipeline-schedule metrics (see tools/mem_report.py for the
@@ -164,12 +210,12 @@ def main(argv=None):
         print(__doc__.strip())
         return 0 if argv and argv[0] in ("-h", "--help") else 2
     path, records = load_records(argv[0])
-    phases, steps, counters, gauges = collect(records)
-    if not (phases or steps or counters or gauges):
+    phases, steps, counters, gauges, hists = collect(records)
+    if not (phases or steps or counters or gauges or hists):
         print(f"{path}: no telemetry/* scalars found", file=sys.stderr)
         return 1
     print(f"telemetry report — {path}")
-    print(build_table(phases, steps, counters, gauges))
+    print(build_table(phases, steps, counters, gauges, hists))
     return 0
 
 
