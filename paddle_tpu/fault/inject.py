@@ -23,31 +23,57 @@ point                   instrumented site
 ``dispatch``            ``jit.CompiledStep`` device dispatch — ``oom``
                         raises a ``RESOURCE_EXHAUSTED`` stand-in that
                         exercises the devprof OOM-forensics path
+``serve.admit``         ``serving.Scheduler.submit`` admission decision —
+                        ``error`` sheds the request (terminal
+                        ``finish_reason='shed'``) instead of queueing it
+``serve.prefill``       ``serving.GenerationEngine.prefill`` — fires BEFORE
+                        the compiled step so the donated KV cache is still
+                        valid; ``error`` is absorbed by the scheduler's
+                        jittered retry, ``oom`` triggers victim eviction
+``serve.decode``        ``serving.GenerationEngine.decode_once`` — same
+                        cache-safe placement; ``oom`` drives the degraded
+                        decode path (evict largest victim, retry tick)
+``serve.evict``         ``serving.Scheduler._evict`` — an injected
+                        ``error`` must NOT lose the request (eviction
+                        completes; counted as ``serve.evict_faults``)
 ======================  ======================================================
+
+Only the points above are known; arming an unknown point raises the same
+``ValueError`` as an unknown kind (typos must fail loudly, not silently
+never fire).
 
 Arming: programmatic ``arm(kind, point, at=N, once_file=...)`` or the
 ``PADDLE_TPU_FAULT_INJECT`` env var (``kind:point:at[:once_file]``,
-comma-separated) — the env form survives ``forkserver`` into DataLoader
-worker processes. ``once_file`` gives cross-process once-only semantics: the
-first process to claim the file (O_EXCL create) fires; respawned workers
-re-hitting the same sample index do not die again.
+comma-separated, e.g. ``oom:serve.decode:3,error:serve.prefill:1``) — the
+env form survives ``forkserver`` into DataLoader worker processes.
+``once_file`` gives cross-process once-only semantics: the first process to
+claim the file (O_EXCL create) fires; respawned workers re-hitting the same
+sample index do not die again.
 
-Kinds: ``sigterm`` | ``kill`` | ``error`` | ``oom`` (raised from ``check``)
-and ``torn`` (returned from ``check`` for the writer to act on).
+Kinds: ``sigterm`` | ``kill`` | ``error`` | ``oom`` (raised from ``check``),
+``torn`` (returned from ``check`` for the writer to act on) and ``stall``
+(``check`` sleeps ``PADDLE_TPU_FAULT_STALL_S`` seconds — default 0.05 —
+then returns ``"stall"``: a slow request, not a dead one; the chaos
+harness uses it to push requests past their deadlines).
 """
 from __future__ import annotations
 
 import os
 import signal
 import threading
+import time
 
 from .retry import TransientError
 
 __all__ = ["arm", "disarm_all", "check", "armed", "TransientError",
-           "InjectedResourceExhausted", "KINDS", "ENV_VAR"]
+           "InjectedResourceExhausted", "KINDS", "POINTS", "ENV_VAR",
+           "STALL_ENV_VAR"]
 
 ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
-KINDS = ("sigterm", "kill", "error", "torn", "oom")
+STALL_ENV_VAR = "PADDLE_TPU_FAULT_STALL_S"
+KINDS = ("sigterm", "kill", "error", "torn", "oom", "stall")
+POINTS = ("ckpt.write", "train.step", "stage", "worker.fetch", "dispatch",
+          "serve.admit", "serve.prefill", "serve.decode", "serve.evict")
 
 
 class InjectedResourceExhausted(RuntimeError):
@@ -63,6 +89,8 @@ def _arm_locked(kind, point, at=1, once_file=None):
     """Append one armed entry; caller holds (or doesn't need) ``_lock``."""
     if kind not in KINDS:
         raise ValueError(f"unknown fault kind {kind!r}; one of {KINDS}")
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r}; one of {POINTS}")
     if at < 1:
         raise ValueError("at must be >= 1")
     _armed.append({"kind": kind, "point": point, "at": int(at),
@@ -166,4 +194,11 @@ def check(point):
         raise InjectedResourceExhausted(
             f"RESOURCE_EXHAUSTED: injected out-of-memory at {point!r} "
             f"(fault injection)")
+    if kind == "stall":
+        try:
+            stall_s = float(os.environ.get(STALL_ENV_VAR, "") or 0.05)
+        except ValueError:
+            stall_s = 0.05
+        time.sleep(stall_s)
+        return "stall"
     return "torn"

@@ -43,7 +43,26 @@ __all__ = [
     "log_alert_sink",
     "JsonlAlertSink",
     "DEFAULT_WINDOWS",
+    "SERVING_SLOS",
 ]
+
+#: Shipped serving-overload objectives (``Scheduler(slo=
+#: serving.default_slo_monitor())`` wires them in). Counter rates and
+#: histogram percentiles ONLY — never the ``serve.requests_in_flight`` /
+#: ``serve.queue_depth`` gauges, which are RETIRED (absent, not 0) once a
+#: scheduler drains; a gauge-based spec would fall through to the
+#: counters-read-as-0 path and silently stop measuring. The rate specs
+#: page when sheds/timeouts/OOM evictions burn faster than ~1/s across
+#: the burn windows — i.e. sustained overload, not a single rejected
+#: request.
+SERVING_SLOS = (
+    "serve.shed rate < 1 @ 0.999",
+    "serve.timeouts rate < 1 @ 0.999",
+    "serve.oom_evictions rate < 1 @ 0.999",
+    "serve.errors rate < 1 @ 0.999",
+    "serve.latency_s p95 < 2.0 @ 0.99",
+    "serve.ttft_s p95 < 1.0 @ 0.99",
+)
 
 #: (window_seconds, burn-rate threshold): fast page at 14.4x (2% of a
 #: 30-day budget in an hour, scaled down to serving-loop timescales) plus a

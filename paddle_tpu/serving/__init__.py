@@ -2,10 +2,14 @@
 
 Static-shape KV-cache autoregressive decode (compile once per length
 bucket for prefill, exactly once for decode — O(1) per generated token)
-plus a slot-based continuous-batching scheduler. See ``kv_cache.py`` for
-the cache/compiler contract, ``engine.py`` for the prefill/decode split,
-``scheduler.py`` for request scheduling, and ``tools/bench_serve.py`` for
-the throughput/latency benchmark.
+plus a slot-based continuous-batching scheduler with a resilience layer
+(deadlines, admission control / load shedding, OOM-safe degraded decode —
+every request ends with exactly one terminal ``finish_reason`` from
+``FINISH_REASONS``). See ``kv_cache.py`` for the cache/compiler contract,
+``engine.py`` for the prefill/decode split, ``scheduler.py`` for request
+scheduling and the failure story, ``tools/bench_serve.py`` for the
+throughput/latency benchmark and ``tools/chaos_serve.py`` for the
+deterministic chaos harness.
 """
 from .kv_cache import (  # noqa: F401
     KVCache,
@@ -15,7 +19,13 @@ from .kv_cache import (  # noqa: F401
     pick_bucket,
 )
 from .engine import GenerationEngine, EncoderScorer  # noqa: F401
-from .scheduler import Request, Scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    FINISH_REASONS,
+    CostAwareAdmission,
+    Request,
+    Scheduler,
+    default_slo_monitor,
+)
 
 __all__ = [
     "KVCache",
@@ -27,4 +37,7 @@ __all__ = [
     "EncoderScorer",
     "Request",
     "Scheduler",
+    "FINISH_REASONS",
+    "CostAwareAdmission",
+    "default_slo_monitor",
 ]

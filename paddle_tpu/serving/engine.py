@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..fault import inject as _inject
 from ..framework.tensor import Tensor
 from ..jit.functionalize import CompiledStep
 from ..profiler import tracing as _tracing
@@ -199,6 +200,10 @@ class GenerationEngine:
         # span nests under the caller's context (a scheduler's per-request
         # prefill span, or roots its own trace standalone); the compiled
         # step's compile event lands inside it on a cold bucket
+        # fault-injection point BEFORE the compiled call: the cache rides
+        # donate_inputs, so a fault raised here leaves it un-donated and
+        # the scheduler's retry runs against valid buffers
+        _inject.check("serve.prefill")
         with _tracing.span("serve_prefill",
                            attrs={"slot": int(slot), "bucket": bucket,
                                   "prompt_tokens": int(prompt.size)}):
@@ -211,6 +216,7 @@ class GenerationEngine:
         """One batched decode step: ``last_tokens[b]`` is each slot's most
         recent token. Returns the next token per slot (np int32 [b])."""
         feed = np.asarray(last_tokens, np.int32).reshape(self.max_batch, 1)
+        _inject.check("serve.decode")  # pre-donation: cache-safe on retry
         with _tracing.span("serve_decode"):
             tok, cache = self._decode_step(feed, self.cache)
         self.cache = cache

@@ -6,25 +6,61 @@ finished sequence's slot is handed to the next queued request instead of
 waiting for the whole batch to drain (the static-batch waste). Each
 scheduler ``step()``:
 
-1. **admit** — pop queued requests into free slots (FIFO, lowest slot
+1. **expire** — evict queued requests past their queue-wait budget and
+   active requests past their deadline (terminal ``finish_reason
+   'timeout'``), freeing their slots for this tick's admit;
+2. **admit** — pop queued requests into free slots (FIFO, lowest slot
    first: deterministic given a deterministic arrival stream) and prefill
    each prompt into its slot;
-2. **decode** — ONE batched ``serve_decode`` over every active slot;
-3. **evict** — retire sequences that hit EOS or their token budget,
+3. **decode** — ONE batched ``serve_decode`` over every active slot;
+4. **evict** — retire sequences that hit EOS or their token budget,
    freeing their slots for the next admit.
+
+Resilience contract (ISSUE 10): every request, on every path, ends with
+EXACTLY ONE terminal ``finish_reason`` from :data:`FINISH_REASONS` —
+
+========  ===================================================================
+reason    path
+========  ===================================================================
+eos       decode emitted the request's ``eos_id``
+length    ``max_new_tokens`` generated
+timeout   ``deadline_s`` (total) or ``max_queue_s`` (queue wait) exceeded
+shed      rejected at submit: bounded queue full, admission policy said
+          no, or an injected ``serve.admit`` fault
+oom_evicted  chosen as the largest-footprint victim of a
+          ``RESOURCE_EXHAUSTED`` decode/prefill (survivors keep streaming)
+error     prefill failed past the jittered retry budget
+drained   terminated by ``drain()``/``shutdown()`` instead of being
+          dropped silently
+========  ===================================================================
+
+Overload handling: ``Scheduler(max_queue=N)`` bounds the submit queue
+(reject-on-full → ``shed``); ``admission=CostAwareAdmission(...)`` sheds
+when the estimated backlog cost (prefill bucket + decode budget per
+request) exceeds its cap. Device faults: ``RESOURCE_EXHAUSTED`` raised by
+the decode/prefill step is caught, the largest-footprint victim request is
+evicted (``serve.oom_evictions``), and the tick retries at the reduced
+active batch through :func:`paddle_tpu.fault.retry` jittered backoff
+(``serve.degraded_steps`` counts ticks that degraded). The ``serve.*``
+fault-injection points (``paddle_tpu.fault.inject``) fire BEFORE the
+compiled steps so the donated KV cache is still valid on retry;
+``tools/chaos_serve.py`` drives the whole matrix deterministically.
 
 Everything observable goes through the existing telemetry registry
 (``profiler/telemetry.py``): ``serve.requests_in_flight`` /
 ``serve.queue_depth`` gauges, ``serve.admitted`` / ``serve.evicted`` /
 ``serve.tokens_generated`` / ``serve.decode_steps`` / ``serve.slot_steps``
-counters, and per-request ``serve.ttft_s`` / ``serve.tpot_s`` /
-``serve.latency_s`` histograms — ``tools/bench_serve.py`` summarizes them
-into the SERVE json.
+counters, the resilience counters ``serve.shed`` / ``serve.timeouts`` /
+``serve.oom_evictions`` / ``serve.degraded_steps`` / ``serve.drained`` /
+``serve.errors`` / ``serve.evict_faults``, and per-request
+``serve.ttft_s`` / ``serve.tpot_s`` / ``serve.latency_s`` histograms —
+``tools/bench_serve.py`` summarizes them into the SERVE json.
 
 Determinism contract (regression-tested): with a fixed arrival stream and
 seeded model, the admit/evict event log and every generated sequence are
 identical run to run — slots are a min-heap, the active set is iterated in
-slot order, and decoding is greedy.
+slot order, decoding is greedy, and the OOM victim choice is a
+deterministic (footprint, slot) max.
 
 Request-scoped tracing (``profiler/tracing.py``, opt-in): ``submit`` mints
 the request's trace — a ``request`` root span plus a ``queue`` child that
@@ -33,8 +69,11 @@ engine's span and any compile events parent under it); every decode tick
 records one ``decode_token`` span per *active* request over the shared
 batched-dispatch interval (each carries a ``decode_span`` attr naming the
 shared ``decode_step`` span it rode); evict closes the root with the
-finish reason and latency stats. One JSONL export reconstructs the
-request's full life by filtering its trace id.
+finish reason and latency stats. Abnormal terminations additionally record
+an instantaneous event span named after the reason (``shed`` / ``timeout``
+/ ``oom_evicted`` / ``error`` / ``drained``) under the request root, so a
+trace query for shed/timeout events needs no attr filtering. One JSONL
+export reconstructs the request's full life by filtering its trace id.
 
 Gauge lifecycle (mirrors the DeviceLoader fix): ``serve.requests_in_flight``
 and ``serve.queue_depth`` are retired when ``run()`` drains the batch and
@@ -44,6 +83,8 @@ in-flight stats in ``report()`` or a ``/metrics`` scrape.
 SLO hook: pass ``slo=SLOMonitor([...])`` and the scheduler samples it
 every ``slo_check_every`` ticks (plus once at drain) — burn-rate alerts
 fire from inside the serving loop, no sidecar needed.
+:func:`default_slo_monitor` wires up the shipped overload specs
+(:data:`paddle_tpu.profiler.slo.SERVING_SLOS`).
 """
 from __future__ import annotations
 
@@ -55,12 +96,29 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..fault import inject as _inject
+from ..fault.retry import TransientError
+from ..fault.retry import retry as _retry
 from ..profiler import telemetry as _telemetry
 from ..profiler import tracing as _tracing
+from .kv_cache import pick_bucket
 
-__all__ = ["Request", "Scheduler"]
+__all__ = ["Request", "Scheduler", "CostAwareAdmission", "FINISH_REASONS",
+           "default_slo_monitor"]
+
+#: the closed set of terminal finish reasons — every submitted request ends
+#: with exactly one of these, on every path (chaos-harness invariant)
+FINISH_REASONS = ("eos", "length", "timeout", "shed", "oom_evicted",
+                  "error", "drained")
 
 _rid_counter = itertools.count()
+
+
+def _is_oom(err):
+    """Device OOM? (lazy devprof import keeps scheduler import light)."""
+    from ..profiler import devprof
+
+    return devprof.is_oom_error(err)
 
 
 @dataclass
@@ -71,6 +129,12 @@ class Request:
     max_new_tokens: int = 32
     eos_id: int | None = None
     rid: int = field(default_factory=lambda: next(_rid_counter))
+    #: total latency budget in seconds from submit (queue wait included);
+    #: exceeded → evicted with ``finish_reason='timeout'`` at the next tick
+    deadline_s: float | None = None
+    #: queue-wait budget: a request still queued after this many seconds
+    #: times out without ever taking a slot
+    max_queue_s: float | None = None
 
     # lifecycle (ns timestamps on time.perf_counter_ns)
     tokens: list = field(default_factory=list)
@@ -114,29 +178,96 @@ class Request:
         return (self.done_ns - self.submit_ns) / 1e9
 
 
+class CostAwareAdmission:
+    """Optional admission policy: shed when the estimated outstanding work
+    would exceed a token budget.
+
+    A request's cost estimate is its padded prefill bucket plus its decode
+    budget (``pick_bucket(len(prompt)) + max_new_tokens`` — the slot-steps
+    it will consume). The backlog is the summed estimate over the queue
+    plus the REMAINING budget of every active request. Admission requires
+    ``backlog + cost(request) <= max_backlog_tokens``; the default cap is
+    ``headroom × max_batch × max_len`` — roughly ``headroom`` batches'
+    worth of full-capacity work. Deterministic by construction (pure
+    arithmetic over the scheduler's state)."""
+
+    def __init__(self, max_backlog_tokens=None, headroom=2.0):
+        self.max_backlog_tokens = max_backlog_tokens
+        self.headroom = float(headroom)
+
+    def estimate(self, request, engine):
+        bucket = pick_bucket(len(request.prompt), engine.prefill_buckets)
+        return bucket + int(request.max_new_tokens)
+
+    def __call__(self, request, scheduler):
+        eng = scheduler.engine
+        cap = self.max_backlog_tokens
+        if cap is None:
+            cap = self.headroom * eng.max_batch * eng.max_len
+        backlog = sum(self.estimate(q, eng) for q in scheduler.queue)
+        backlog += sum(max(0, r.max_new_tokens - len(r.tokens))
+                       for r in scheduler.active.values())
+        return backlog + self.estimate(request, eng) <= cap
+
+
+def default_slo_monitor(**kwargs):
+    """An :class:`~paddle_tpu.profiler.slo.SLOMonitor` over the shipped
+    serving overload specs (``SERVING_SLOS``) — pass straight to
+    ``Scheduler(slo=default_slo_monitor())``."""
+    from ..profiler.slo import SERVING_SLOS, SLOMonitor
+
+    return SLOMonitor(SERVING_SLOS, **kwargs)
+
+
 class Scheduler:
     """Slot-based continuous-batching scheduler over a
-    :class:`~paddle_tpu.serving.GenerationEngine`."""
+    :class:`~paddle_tpu.serving.GenerationEngine`.
 
-    def __init__(self, engine, slo=None, slo_check_every=8):
+    Resilience knobs (all optional — defaults preserve the PR 6 behavior):
+
+    Args:
+        max_queue: bounded submit queue; a submit past the bound is shed
+            (terminal ``finish_reason='shed'``, returned to the caller)
+            instead of queueing work the tier can never finish.
+        admission: callable ``policy(request, scheduler) -> bool``; False
+            sheds the request. :class:`CostAwareAdmission` ships in the
+            box.
+        retry_tries / retry_base_delay / retry_sleep: the
+            :func:`paddle_tpu.fault.retry` budget used for transient
+            prefill faults and OOM-degraded decode retries (``retry_sleep``
+            is injectable so tests don't sleep).
+        slo / slo_check_every: see the module docstring.
+    """
+
+    def __init__(self, engine, slo=None, slo_check_every=8, max_queue=None,
+                 admission=None, retry_tries=3, retry_base_delay=0.02,
+                 retry_sleep=time.sleep):
         self.engine = engine
         self.queue = deque()
         self.active = {}  # slot -> Request
         self.finished = []
-        self.events = []  # (step_idx, "admit"|"evict", rid, slot)
+        self.events = []  # (step_idx, kind, rid, slot) — kind in
+        # {"admit","evict","shed","timeout","drained","error"}
         self._free = list(range(engine.max_batch))
         heapq.heapify(self._free)
         self._step_idx = 0
         self.decode_steps = 0
         self.slot_steps = 0
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.admission = admission
+        self.retry_tries = max(1, int(retry_tries))
+        self.retry_base_delay = float(retry_base_delay)
+        self.retry_sleep = retry_sleep
         self.slo = slo
         self.slo_check_every = max(1, int(slo_check_every))
         self._session_span = None
 
     # -- submission ----------------------------------------------------------
     def submit(self, request: Request):
-        """Queue a request. Validated against the engine's capacity up
-        front so a doomed request fails at submit, not mid-serve."""
+        """Queue a request, or shed it (terminal ``finish_reason='shed'``)
+        when admission control rejects it — check the returned request's
+        ``finish_reason``. Capacity is validated up front so a doomed
+        request fails at submit with a ``ValueError``, not mid-serve."""
         n = len(request.prompt)
         if n == 0:
             raise ValueError("empty prompt")
@@ -158,23 +289,43 @@ class Scheduler:
                        "max_new_tokens": request.max_new_tokens})
             request.queue_span = _tracing.start_span(
                 "queue", parent=request.trace_span)
-        self.queue.append(request)
-        if _telemetry.enabled():
-            tm = _telemetry.get_telemetry()
+        tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        if tm is not None:
             tm.inc("serve.submitted")
+        # admission control: injected faults, bounded queue, cost policy —
+        # a rejected request ends terminally ('shed'), never silently
+        try:
+            _inject.check("serve.admit")
+        except TransientError:
+            return self._shed(request, "injected admission fault", tm)
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            return self._shed(request, "queue full", tm)
+        if self.admission is not None and not self.admission(request, self):
+            return self._shed(request, "admission policy", tm)
+        self.queue.append(request)
+        if tm is not None:
             tm.set_gauge("serve.queue_depth", len(self.queue))
         return request
 
+    def _shed(self, req, why, tm):
+        self.events.append((self._step_idx, "shed", req.rid, None))
+        self._finish_unadmitted(req, "shed", tm, attrs={"why": why})
+        return req
+
     # -- the serving loop ----------------------------------------------------
     def step(self):
-        """One scheduler tick: admit → batched decode → evict. Returns the
-        requests that finished during this tick."""
+        """One scheduler tick: expire → admit → batched decode → evict.
+        Returns the requests that finished during this tick."""
         tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
         tr = _tracing.enabled()
         if tr and self._session_span is None:
             self._session_span = _tracing.start_span(
                 "serve_session", attrs={"max_batch": self.engine.max_batch})
         done_now = []
+
+        # expire: deadline / queue-wait budgets, BEFORE admit so freed
+        # slots are handed to queued work this very tick
+        self._expire(done_now, tm)
 
         # admit: fill free slots from the queue (FIFO, lowest slot first)
         while self.queue and self._free:
@@ -185,6 +336,7 @@ class Scheduler:
             if tr and req.trace_span is not None:
                 if req.queue_span is not None:
                     req.queue_span.end()
+                    req.queue_span = None
                 prefill_span = _tracing.start_span(
                     "prefill", parent=req.trace_span,
                     attrs={"slot": slot, "prompt_tokens": len(req.prompt),
@@ -192,7 +344,17 @@ class Scheduler:
             # activated so the engine's serve_prefill span (and the bucket
             # compile, if this prompt hits a cold bucket) parent under it
             with _tracing.activate(prefill_span):
-                tok = self.engine.prefill(slot, req.prompt)
+                tok = self._prefill_with_recovery(req, slot, done_now, tm)
+            if tok is None:
+                # transient faults outlasted the retry budget: this request
+                # fails terminally; its slot goes back to the pool
+                if prefill_span is not None:
+                    prefill_span.set_attr("failed", True).end()
+                heapq.heappush(self._free, slot)
+                req.slot = None
+                self.events.append((self._step_idx, "error", req.rid, slot))
+                self._finish_unadmitted(req, "error", tm)
+                continue
             req.first_token_ns = time.perf_counter_ns()
             req.tokens.append(tok)
             if prefill_span is not None:
@@ -206,7 +368,9 @@ class Scheduler:
             if self._exhausted(req):
                 done_now.append(self._evict(req))
 
-        # decode: one batched step over every active slot
+        # decode: one batched step over every active slot; a
+        # RESOURCE_EXHAUSTED tick degrades (evict victim, retry) instead
+        # of killing every in-flight request
         if self.active:
             feed = np.zeros((self.engine.max_batch,), np.int32)
             for slot, req in self.active.items():
@@ -218,31 +382,32 @@ class Scheduler:
                     attrs={"active": len(self.active),
                            "sched_step": self._step_idx})
             with _tracing.activate(decode_span):
-                out = self.engine.decode_once(feed)
+                out = self._decode_with_recovery(feed, done_now, tm)
             if decode_span is not None:
                 decode_span.end()
-            self.decode_steps += 1
-            self.slot_steps += len(self.active)
-            if tm is not None:
-                tm.inc("serve.decode_steps")
-                tm.inc("serve.slot_steps", len(self.active))
-                tm.inc("serve.tokens_generated", len(self.active))
-            for slot in sorted(self.active):
-                req = self.active[slot]
-                req.tokens.append(int(out[slot]))
-                if decode_span is not None and req.trace_span is not None:
-                    # the batched dispatch is SHARED: one span per active
-                    # request over the same interval, linked to the shared
-                    # decode_step span — per-token intervals per request
-                    _tracing.get_tracer().record(
-                        "decode_token", decode_span.start_ns,
-                        decode_span.end_ns, parent=req.trace_span,
-                        attrs={"slot": slot, "token": req.tokens[-1],
-                               "index": len(req.tokens) - 1,
-                               "decode_span": decode_span.span_id,
-                               "decode_trace": decode_span.trace_id})
-                if self._exhausted(req):
-                    done_now.append(self._evict(req))
+            if out is not None:
+                self.decode_steps += 1
+                self.slot_steps += len(self.active)
+                if tm is not None:
+                    tm.inc("serve.decode_steps")
+                    tm.inc("serve.slot_steps", len(self.active))
+                    tm.inc("serve.tokens_generated", len(self.active))
+                for slot in sorted(self.active):
+                    req = self.active[slot]
+                    req.tokens.append(int(out[slot]))
+                    if decode_span is not None and req.trace_span is not None:
+                        # the batched dispatch is SHARED: one span per active
+                        # request over the same interval, linked to the shared
+                        # decode_step span — per-token intervals per request
+                        _tracing.get_tracer().record(
+                            "decode_token", decode_span.start_ns,
+                            decode_span.end_ns, parent=req.trace_span,
+                            attrs={"slot": slot, "token": req.tokens[-1],
+                                   "index": len(req.tokens) - 1,
+                                   "decode_span": decode_span.span_id,
+                                   "decode_trace": decode_span.trace_id})
+                    if self._exhausted(req):
+                        done_now.append(self._evict(req))
 
         self._step_idx += 1
         if tm is not None:
@@ -251,6 +416,131 @@ class Scheduler:
         if self.slo is not None and self._step_idx % self.slo_check_every == 0:
             self.slo.check()
         return done_now
+
+    # -- resilience ----------------------------------------------------------
+    def _expire(self, done_now, tm):
+        """Evict requests past their budgets with ``finish_reason
+        'timeout'``: queued requests check both ``max_queue_s`` and
+        ``deadline_s``; active requests check ``deadline_s``."""
+        now = time.perf_counter_ns()
+        if self.queue:
+            kept = deque()
+            while self.queue:
+                req = self.queue.popleft()
+                waited = (now - req.submit_ns) / 1e9
+                if ((req.max_queue_s is not None
+                     and waited >= req.max_queue_s)
+                        or (req.deadline_s is not None
+                            and waited >= req.deadline_s)):
+                    self.events.append(
+                        (self._step_idx, "timeout", req.rid, None))
+                    self._finish_unadmitted(req, "timeout", tm)
+                else:
+                    kept.append(req)
+            self.queue = kept
+        for slot in sorted(self.active):
+            req = self.active.get(slot)
+            if (req is not None and req.deadline_s is not None
+                    and (now - req.submit_ns) / 1e9 >= req.deadline_s):
+                done_now.append(self._evict(req, reason="timeout"))
+
+    def _prefill_with_recovery(self, req, slot, done_now, tm):
+        """``engine.prefill`` under the fault-retry budget: transient
+        errors back off and retry; a ``RESOURCE_EXHAUSTED`` evicts the
+        largest-footprint victim first (so the retry runs against a
+        lighter cache) — the ``serve.prefill`` injection point fires
+        before the compiled step, so the donated cache is retry-safe.
+        Returns the first token, or None when the request must fail
+        terminally (``finish_reason='error'``)."""
+
+        def attempt():
+            try:
+                return self.engine.prefill(slot, req.prompt)
+            except Exception as e:
+                if _is_oom(e):
+                    victim = self._pick_oom_victim()
+                    if victim is not None:
+                        done_now.append(
+                            self._evict(victim, reason="oom_evicted"))
+                    raise TransientError(
+                        f"prefill RESOURCE_EXHAUSTED (rid {req.rid}); "
+                        f"evicted victim, retrying") from e
+                raise
+
+        try:
+            return _retry(attempt, tries=self.retry_tries,
+                          base_delay=self.retry_base_delay,
+                          retry_on=(TransientError,), sleep=self.retry_sleep)
+        except TransientError:
+            return None
+
+    def _decode_with_recovery(self, feed, done_now, tm):
+        """One batched decode under the fault-retry budget. On
+        ``RESOURCE_EXHAUSTED``: evict the largest-footprint victim
+        (``finish_reason='oom_evicted'``) and retry the tick at the
+        reduced active batch with jittered backoff — survivors keep
+        streaming. Returns the per-slot tokens, or None when every active
+        request was evicted before a decode succeeded."""
+        degraded = False
+
+        def attempt():
+            nonlocal degraded
+            if not self.active:
+                return None
+            try:
+                return self.engine.decode_once(feed)
+            except Exception as e:
+                if not _is_oom(e):
+                    raise
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    raise
+                degraded = True
+                vslot = victim.slot
+                done_now.append(self._evict(victim, reason="oom_evicted"))
+                feed[vslot] = 0
+                raise TransientError(
+                    f"decode RESOURCE_EXHAUSTED; evicted rid {victim.rid} "
+                    f"(slot {vslot}), retrying at batch "
+                    f"{len(self.active)}") from e
+
+        # one eviction per attempt: worst case sheds the whole batch
+        out = _retry(attempt, tries=self.engine.max_batch + 1,
+                     base_delay=self.retry_base_delay,
+                     retry_on=(TransientError,), sleep=self.retry_sleep)
+        if degraded and tm is not None:
+            tm.inc("serve.degraded_steps")
+        return out
+
+    def _pick_oom_victim(self):
+        """The active request holding the most KV-cache tokens (prompt +
+        generated); ties break toward the highest slot — deterministic, so
+        chaos runs are replayable."""
+        if not self.active:
+            return None
+        return max(self.active.values(),
+                   key=lambda r: (len(r.prompt) + len(r.tokens), r.slot))
+
+    def drain(self):
+        """Terminate ALL outstanding work with ``finish_reason='drained'``
+        — queued requests finish without ever taking a slot, active
+        requests are evicted keeping their partial tokens — then retire
+        the lifecycle gauges and take a final SLO sample. Nothing is
+        dropped silently: afterwards every submitted request is in
+        ``finished`` with a terminal reason. Returns ``finished``."""
+        tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        while self.queue:
+            req = self.queue.popleft()
+            self.events.append((self._step_idx, "drained", req.rid, None))
+            self._finish_unadmitted(req, "drained", tm)
+        for slot in sorted(self.active):
+            req = self.active.get(slot)
+            if req is not None:
+                self._evict(req, reason="drained")
+        self._retire_gauges()
+        if self.slo is not None:
+            self.slo.check()
+        return self.finished
 
     def run(self, max_steps=None):
         """Drive ``step()`` until the queue and the batch drain (or
@@ -279,11 +569,12 @@ class Scheduler:
         tm.clear_gauge("serve.queue_depth")
 
     def shutdown(self):
-        """Explicit teardown: retire the serve gauges and close the
-        tracing session span. Safe to call repeatedly; the scheduler stays
-        usable (a later ``step()`` republishes gauges and reopens a
+        """Explicit teardown: drain outstanding work (terminal
+        ``finish_reason='drained'``), retire the serve gauges and close
+        the tracing session span. Safe to call repeatedly; the scheduler
+        stays usable (a later ``step()`` republishes gauges and reopens a
         session span)."""
-        self._retire_gauges()
+        self.drain()
         if self._session_span is not None:
             self._session_span.set_attr("decode_steps", self.decode_steps)
             self._session_span.end()
@@ -299,13 +590,69 @@ class Scheduler:
             return True
         return False
 
-    def _evict(self, req):
+    def _account_reason(self, tm, reason):
+        counter = {"shed": "serve.shed", "timeout": "serve.timeouts",
+                   "oom_evicted": "serve.oom_evictions",
+                   "drained": "serve.drained",
+                   "error": "serve.errors"}.get(reason)
+        if tm is not None and counter is not None:
+            tm.inc(counter)
+
+    def _record_event_span(self, req, name, attrs=None):
+        """Instantaneous event span under the request root — shed/timeout/
+        evict events are queryable by span NAME, not just root attrs."""
+        now = time.perf_counter_ns()
+        _tracing.get_tracer().record(
+            name, now, now, parent=req.trace_span,
+            attrs={"rid": req.rid, **(attrs or {})})
+
+    def _finish_unadmitted(self, req, reason, tm, attrs=None):
+        """Terminal bookkeeping for a request that never held a slot
+        (shed / queue timeout / drained-from-queue / prefill error)."""
+        if req.finished:
+            return req
+        if reason not in FINISH_REASONS:
+            raise ValueError(f"internal: finish reason {reason!r} not in "
+                             f"{FINISH_REASONS}")
+        req.finish_reason = reason
+        req.done_ns = time.perf_counter_ns()
+        self.finished.append(req)
+        if req.queue_span is not None:
+            req.queue_span.end()
+            req.queue_span = None
+        if req.trace_span is not None:
+            self._record_event_span(req, reason, attrs)
+            req.trace_span.set_attr("finish_reason", reason)
+            req.trace_span.set_attr("tokens", len(req.tokens))
+            req.trace_span.end()
+        self._account_reason(tm, reason)
+        return req
+
+    def _evict(self, req, reason=None):
+        if req.finished:  # exactly-one-terminal-reason guard
+            return req
+        if reason is not None:
+            if reason not in FINISH_REASONS:
+                raise ValueError(f"internal: finish reason {reason!r} not "
+                                 f"in {FINISH_REASONS}")
+            req.finish_reason = reason
+        tm = _telemetry.get_telemetry() if _telemetry.enabled() else None
+        try:
+            _inject.check("serve.evict")
+        except TransientError:
+            # eviction must complete — a faulting evict path may not lose
+            # the request's accounting
+            if tm is not None:
+                tm.inc("serve.evict_faults")
         req.done_ns = time.perf_counter_ns()
         self.active.pop(req.slot, None)
         heapq.heappush(self._free, req.slot)
         self.events.append((self._step_idx, "evict", req.rid, req.slot))
         self.finished.append(req)
         if req.trace_span is not None:
+            if req.finish_reason not in ("eos", "length"):
+                self._record_event_span(req, req.finish_reason,
+                                        {"slot": req.slot})
             req.trace_span.set_attr("finish_reason", req.finish_reason)
             req.trace_span.set_attr("tokens", len(req.tokens))
             if req.ttft_s is not None:
@@ -313,9 +660,9 @@ class Scheduler:
             if req.latency_s is not None:
                 req.trace_span.set_attr("latency_s", req.latency_s)
             req.trace_span.end()
-        if _telemetry.enabled():
-            tm = _telemetry.get_telemetry()
+        if tm is not None:
             tm.inc("serve.evicted")
+            self._account_reason(tm, req.finish_reason)
             if req.ttft_s is not None:
                 tm.observe("serve.ttft_s", req.ttft_s)
             if req.tpot_s is not None:

@@ -92,7 +92,10 @@ def extract_serve(doc):
             (("telemetry", "compiles", "serve_decode"),
              "decode_compiles", "equal"),
             (("decode_lint", "shape_churn_findings"),
-             "shape_churn_findings", "lower")):
+             "shape_churn_findings", "lower"),
+            # chaos_serve verdict (1.0 = every resilience contract held);
+            # 'equal' direction: ANY flip from the baseline is a regression
+            (("chaos_ok",), "chaos_ok", "equal")):
         v = _get(doc, *path)
         if isinstance(v, (int, float)):
             out[name] = (float(v), direction)

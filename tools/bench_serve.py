@@ -200,6 +200,10 @@ def main(argv=None):
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--artifact", default=None)
+    ap.add_argument("--chaos", action="store_true",
+                    help="also run tools/chaos_serve.py and embed its "
+                         "verdict as the chaos_ok contract metric (the "
+                         "bench_sentinel 'equal'-direction gate)")
     args = ap.parse_args(argv)
 
     if args.smoke:
@@ -251,6 +255,18 @@ def main(argv=None):
         "decode_lint": lint,
         "telemetry": tblock,
     }
+    chaos = None
+    if args.chaos:
+        # the chaos contract is config-independent, so the harness always
+        # runs its own tiny deterministic config — cheap even when the
+        # bench itself ran gpt2-124M
+        import chaos_serve
+
+        chaos = chaos_serve.run_chaos(seed=args.seed)
+        result["chaos_ok"] = 1.0 if chaos["ok"] else 0.0
+        result["chaos"] = {k: chaos[k] for k in
+                           ("finish_reasons", "survivors", "slo_alerts",
+                            "problems")}
     print(json.dumps(result))
     if args.artifact:
         with open(args.artifact, "w") as f:
@@ -274,6 +290,8 @@ def main(argv=None):
     if lint["shape_churn_findings"]:
         problems.append(f"decode lint: {lint['shape_churn_findings']} "
                         f"shape-churn/kv-cache finding(s)")
+    if chaos is not None and not chaos["ok"]:
+        problems.append("chaos harness: " + "; ".join(chaos["problems"]))
     if problems:
         print("bench_serve FAILED: " + "; ".join(problems), file=sys.stderr)
         return 1

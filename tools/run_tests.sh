@@ -145,6 +145,12 @@ PYEOF
   # bucket, zero shape-churn/kv-cache lint findings on the decode step
   JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke \
     --artifact "$SMOKE_DIR/serve_smoke.json"
+  # serving chaos gate (ISSUE 10): flood the scheduler under injected
+  # OOM/transient-error/stall faults and hard-assert the resilience
+  # contract — every request ends with exactly one terminal
+  # finish_reason, survivors match the clean run token-for-token, the
+  # overload SLOs page, and post-chaos throughput recovers to >=90%
+  JAX_PLATFORMS=cpu python tools/chaos_serve.py --smoke
   # checkpoint-doctor smoke: write two CheckpointManager steps (one torn
   # via fault injection), then exercise the verify/inspect/prune CLI —
   # verify MUST flag the torn step (exit 1) and pass the intact one
@@ -221,16 +227,42 @@ PYEOF
   rm -rf "$SMOKE_DIR"
 fi
 
+# Run pytest with a single retry-on-crash (PR 7 HOST NOTE): this pool host
+# intermittently SIGABRTs/segfaults inside XLA:CPU dispatch mid-suite. A
+# crash exit (rc >= 128) with NO test failures recorded in the log is that
+# host flake, not a red suite — re-run once before reporting red. A run
+# with real failures (or a second crash) still exits nonzero.
+run_pytest() {
+  local log rc
+  log="$(mktemp /tmp/pt_pytest_run.XXXXXX.log)"
+  set +e
+  "${PY[@]}" "$@" 2>&1 | tee "$log"
+  rc=${PIPESTATUS[0]}
+  set -e
+  if (( rc >= 128 )) && \
+      ! grep -qaE '^(FAILED|ERROR)[ :]|[0-9]+ (failed|errors?)' "$log"; then
+    echo "run_tests.sh: pytest crashed (rc=$rc) with no test failures in" \
+         "the log — retrying once (intermittent XLA dispatch crash on this" \
+         "pool host; see the PR 7 HOST NOTE)" >&2
+    set +e
+    "${PY[@]}" "$@" 2>&1 | tee "$log"
+    rc=${PIPESTATUS[0]}
+    set -e
+  fi
+  rm -f "$log"
+  return "$rc"
+}
+
 case "$MODE" in
   full)
-    exec "${PY[@]}" tests/ "${ARGS[@]:-}"
+    run_pytest tests/ "${ARGS[@]:-}"
     ;;
   fast)
     IGNORES=()
     for f in "${SLOW_FILES[@]}"; do IGNORES+=("--ignore=$f"); done
-    exec "${PY[@]}" tests/ "${IGNORES[@]}" "${ARGS[@]:-}"
+    run_pytest tests/ "${IGNORES[@]}" "${ARGS[@]:-}"
     ;;
   slow)
-    exec "${PY[@]}" "${SLOW_FILES[@]}" "${ARGS[@]:-}"
+    run_pytest "${SLOW_FILES[@]}" "${ARGS[@]:-}"
     ;;
 esac
