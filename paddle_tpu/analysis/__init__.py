@@ -30,20 +30,30 @@ from .graph_lint import (  # noqa: F401
 )
 from .crosscheck import (  # noqa: F401
     COMM_RTOL,
+    MEM_RTOL,
     RETRACE_RULES,
     crosscheck_comm,
+    crosscheck_mem,
     crosscheck_telemetry,
 )
 from .rules import RULES, register_rule, rule_ids  # noqa: F401
+from . import mem_lint  # noqa: F401
 from . import shard_lint  # noqa: F401
+from .mem_lint import (  # noqa: F401
+    MEM_LINT_DEFAULTS,
+    MemoryTimeline,
+    analyze_memory,
+)
 from .shard_lint import ShardingAnalysis, analyze_sharding  # noqa: F401
 
 __all__ = [
     "SEVERITIES", "Finding", "LintReport", "StepGraph", "LINT_DEFAULTS",
     "lint_step", "trace_step", "crosscheck_telemetry", "RETRACE_RULES",
     "crosscheck_comm", "COMM_RTOL", "sarif_report",
+    "crosscheck_mem", "MEM_RTOL",
     "RULES", "register_rule", "rule_ids",
     "shard_lint", "ShardingAnalysis", "analyze_sharding",
+    "mem_lint", "MemoryTimeline", "analyze_memory", "MEM_LINT_DEFAULTS",
     "enable_lint_on_compile", "lint_on_compile_enabled", "autolint",
 ]
 

@@ -17,11 +17,18 @@ as ``agrees=False``.
 from __future__ import annotations
 
 __all__ = ["RETRACE_RULES", "crosscheck_telemetry", "crosscheck_comm",
-           "COMM_RTOL"]
+           "COMM_RTOL", "crosscheck_mem", "MEM_RTOL"]
 
 #: default relative tolerance for predicted-vs-measured collective bytes
 #: (explicit shard_map collectives are exact; GSPMD propagation is a model)
 COMM_RTOL = 0.10
+
+#: default relative tolerance for predicted-vs-measured HBM peak bytes.
+#: Looser than COMM_RTOL on purpose: the liveness timeline is an upper
+#: bound (XLA fusion elides temporaries the jaxpr materializes, and the
+#: allocator packs lifetimes tighter than per-eqn granularity) — but it
+#: must never UNDER-predict the compiled peak beyond this gate.
+MEM_RTOL = 0.15
 
 #: rules whose findings predict >1 compilation of the step
 RETRACE_RULES = frozenset({
@@ -139,3 +146,70 @@ def crosscheck_comm(predicted, measured=None, rtol=COMM_RTOL):
         rows.append({"axis": axis, "predicted_bytes": p,
                      "measured_bytes": m, "ratio": ratio, "agrees": agrees})
     return rows
+
+
+def _peak_bytes_of(obj):
+    """Coerce a peak-carrying shape into (peak_bytes, alias_unavailable):
+    a ``MemoryTimeline``, a devprof ``DeviceCostReport`` /
+    ``MemoryBreakdown``, a plain number, or a dict with ``peak_bytes``."""
+    alias_unavailable = False
+    mem = getattr(obj, "memory", None)
+    if mem is not None:  # DeviceCostReport
+        obj = mem
+    if isinstance(obj, dict):
+        peak = obj.get("peak_bytes")
+        alias_unavailable = bool(obj.get("alias_unavailable", False))
+    elif isinstance(obj, (int, float)):
+        peak = obj
+    else:
+        peak = getattr(obj, "peak_bytes", None)
+        alias_unavailable = bool(getattr(obj, "alias_unavailable", False))
+    if peak is None:
+        raise TypeError(f"cannot read peak bytes from {type(obj)!r}")
+    return float(peak), alias_unavailable
+
+
+def crosscheck_mem(predicted, measured, rtol=MEM_RTOL):
+    """Join mem-lint's *predicted* HBM peak with XLA's *measured* one
+    (``compiled.memory_analysis()`` via devprof).
+
+    The prediction is documented as an upper bound: XLA fusion elides
+    temporaries the abstract timeline materializes, so moderate
+    over-prediction within ``rtol`` is expected — an UNDER-prediction
+    beyond ``rtol`` is a mem-lint bug (``under_predicted=True``).
+
+    Args:
+        predicted: a ``mem_lint.MemoryTimeline`` (or number / dict with
+            ``peak_bytes``).
+        measured: a ``devprof.DeviceCostReport`` / ``MemoryBreakdown`` /
+            number / ``memory_analysis`` dict. A measurement flagged
+            ``alias_unavailable`` (persistent-cache-deserialized
+            executable — its alias term is unreliable) is *skipped*, not
+            gated.
+
+    Returns:
+        One row (list of one dict, shaped like :func:`crosscheck_comm`)::
+
+            {"metric": "peak_bytes", "predicted_bytes", "measured_bytes",
+             "ratio",              # predicted / measured (None when m==0)
+             "agrees": bool|None,  # within rtol; None when skipped
+             "under_predicted": bool,  # p < m beyond rtol (the real bug)
+             "skipped": str|None}  # reason, when agrees is None
+    """
+    p, _ = _peak_bytes_of(predicted)
+    m, alias_unavailable = _peak_bytes_of(measured)
+    row = {"metric": "peak_bytes", "predicted_bytes": p,
+           "measured_bytes": m, "ratio": None, "agrees": None,
+           "under_predicted": False, "skipped": None}
+    if alias_unavailable:
+        row["skipped"] = ("measured breakdown has alias_unavailable=True "
+                          "(persistent-cache executable): peak is not "
+                          "trustworthy, not gating")
+        return [row]
+    if m > 0:
+        row["ratio"] = p / m
+        row["agrees"] = abs(p - m) <= rtol * m
+        row["under_predicted"] = p < m - rtol * m
+    else:
+        row["agrees"] = p == 0
+    return [row]

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 
 from .findings import LintReport
+from .mem_lint import MEM_LINT_DEFAULTS
 from .rules import run_rules
 from .shard_lint import SHARD_LINT_DEFAULTS
 
@@ -32,6 +33,7 @@ LINT_DEFAULTS = {
     "const_warn_bytes": 1 << 20,   # hbm-const-folded warning floor
     "const_error_bytes": 64 << 20,  # …and the error escalation point
     **SHARD_LINT_DEFAULTS,         # spmd-* rule thresholds (ISSUE 7)
+    **MEM_LINT_DEFAULTS,           # hbm-* liveness thresholds (ISSUE 12)
 }
 
 
@@ -132,6 +134,9 @@ class StepGraph:
         # populated by lint_step when a mesh is in play: the abstract SPMD
         # propagation (shard_lint.ShardingAnalysis) the spmd-* rules read
         self.sharding = None
+        # populated by lint_step: the abstract liveness timeline
+        # (mem_lint.MemoryTimeline) the hbm-* rules read
+        self.memory = None
 
         def _paths(prefix, tree):
             return [(_path_str(prefix, p), l) for p, l in
@@ -279,6 +284,14 @@ def lint_step(step, *args, extra_args=(), ignore=(), config=None, mesh=None,
         warnings.warn(f"shard lint propagation failed on '{graph.name}': "
                       f"{e!r}", RuntimeWarning, stacklevel=2)
         graph.sharding = None
+    try:
+        from . import mem_lint
+
+        graph.memory = mem_lint.analyze_memory(graph)
+    except Exception as e:  # noqa: BLE001 - the liveness pass is advisory
+        warnings.warn(f"mem lint timeline failed on '{graph.name}': "
+                      f"{e!r}", RuntimeWarning, stacklevel=2)
+        graph.memory = None
     # per-call ignore applies first; the env var adds on top (union) — a
     # per-call list can therefore never un-silence an env-ignored rule
     ignore = (_check_ignore(tuple(ignore), "ignore=")
@@ -287,4 +300,6 @@ def lint_step(step, *args, extra_args=(), ignore=(), config=None, mesh=None,
     # expose the propagation to callers (CLI tables, crosscheck_comm) —
     # None when no mesh was in play
     report.sharding = graph.sharding
+    # …and the liveness timeline (CLI tables, crosscheck_mem)
+    report.memory = graph.memory
     return report

@@ -1,0 +1,826 @@
+"""Abstract HBM liveness analysis: predict peak memory before compile.
+
+The memory twin of :mod:`.shard_lint` (same no-device-execution contract as
+``trace_step``): one linear pass over the step jaxpr builds a
+:class:`MemoryTimeline` — the live-set bytes at every equation, the
+predicted peak, and the top-k peak contributors with pytree-path / eqn
+provenance. The walk honors
+
+* **donation aliasing** — buffers named by ``donate_inputs`` /
+  ``donate_state`` die at their last use, and an output of identical
+  shape+dtype born at (or after) that point reuses the storage (the
+  ``alias`` term of devprof's :class:`~paddle_tpu.profiler.devprof.
+  MemoryBreakdown`, computed statically);
+* **const folding** — captured constants are resident for the whole
+  program (they are baked into the executable);
+* **control flow** — recursion into ``pjit`` / ``scan`` / ``while`` /
+  ``cond`` / ``custom_vjp`` bodies. Scan carries and stacked inputs stay
+  live across the loop; stacked scan outputs later consumed by another
+  scan are tagged ``residual`` (the classic fwd/bwd pair ``jax.grad``
+  builds — the activations held for the backward);
+* **per-shard LOCAL shapes** — when a Mesh is in play the walk reuses
+  shard_lint's propagated specs, so every byte count is per-device.
+
+Accuracy contract (crosschecked in :func:`.crosscheck.crosscheck_mem`
+against ``compiled.memory_analysis()``): the prediction is an *upper
+bound*. XLA's fusion pass elides temporaries the jaxpr materializes
+(arxiv 2301.13062) and the BFC allocator packs lifetimes tighter than the
+per-eqn granularity here — the timeline must therefore never UNDER-predict
+the compiled peak beyond the rtol gate, while modest over-prediction is
+expected and safe for capacity planning.
+
+Consumers: the ``hbm-*`` registry rules (:mod:`.rules`), the serving
+tier's bytes-based admission policy
+(``serving.scheduler.CostAwareAdmission``), and the auto-parallel
+planner's capacity pruning (``distributed.auto_parallel``).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import shard_lint
+from .shard_lint import (
+    _CALL_PRIMS,
+    _R,
+    _REDUCE_PRIMS,
+    ShardingAnalysis,
+    _aval_bytes,
+    _coerce_spec,
+    _dedupe_axes,
+    _graph_invar_leaves,
+    _local_bytes,
+    spec_from_sharding,
+)
+
+__all__ = [
+    "MEM_LINT_DEFAULTS",
+    "BufferLife",
+    "MemoryTimeline",
+    "analyze_memory",
+    "timeline_from_jaxpr",
+    "device_capacity_bytes",
+]
+
+#: default thresholds for the hbm-* timeline rules (merged into
+#: ``graph_lint.LINT_DEFAULTS`` → ``StepGraph.config``)
+MEM_LINT_DEFAULTS = {
+    "hbm_capacity_bytes": None,     # None → auto-detect (device_capacity_bytes)
+    "remat_min_bytes": 8 << 20,     # hbm-remat-candidate size floor
+    "remat_min_span": 0.35,         # …and lifetime floor (fraction of program)
+    "spike_fraction": 0.50,         # hbm-liveness-spike: one eqn vs peak
+    "spike_min_bytes": 1 << 20,     # …and absolute floor (skip toy programs)
+    "kv_waste_fraction": 0.25,      # hbm-kv-bucket-waste padding threshold
+    "mem_top_k": 8,                 # contributors listed in reports/findings
+}
+
+
+def _fmt_bytes(n):
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{int(n)} B" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+
+
+def device_capacity_bytes():
+    """Per-device HBM budget from the runtime, or None when the backend
+    doesn't report one (XLA:CPU / forced-host meshes)."""
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats:
+            cap = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            if cap:
+                return int(cap)
+    except Exception:
+        pass
+    return None
+
+
+def _shape_dtype(aval):
+    shape = tuple(int(s) for s in getattr(aval, "shape", ()))
+    dtype = getattr(aval, "dtype", None)
+    try:
+        return shape, str(np.dtype(dtype))
+    except TypeError:  # extended dtypes (PRNG keys)
+        return shape, str(dtype)
+
+
+class BufferLife:
+    """One logical buffer's lifetime on the timeline.
+
+    ``birth``/``death`` are step indices (inclusive; ``birth=-1`` means
+    resident from program entry). ``aliases`` names the donated input key
+    whose storage this (output) buffer reuses — an aliased buffer
+    contributes zero *new* bytes to the live set."""
+
+    __slots__ = ("key", "nbytes", "kind", "path", "where", "shape", "dtype",
+                 "donated", "birth", "last_use", "death", "is_output",
+                 "aliases", "tag")
+
+    def __init__(self, key, nbytes, kind="temp", path="", where="",
+                 shape=(), dtype="", donated=False, birth=-1, tag=""):
+        self.key = int(key)
+        self.nbytes = float(nbytes)
+        self.kind = kind            # "input" | "const" | "temp"
+        self.path = path
+        self.where = where
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.donated = bool(donated)
+        self.birth = int(birth)
+        self.last_use = int(birth)
+        self.death = -2             # set by finalize
+        self.is_output = False
+        self.aliases = None         # key of the donated input it reuses
+        self.tag = tag              # "" | "scan-slice" | "scan-ys" | "residual"
+
+    @property
+    def eff_bytes(self):
+        return 0.0 if self.aliases is not None else self.nbytes
+
+    def as_dict(self):
+        return {"kind": self.kind, "path": self.path, "where": self.where,
+                "shape": list(self.shape), "dtype": self.dtype,
+                "nbytes": self.nbytes, "birth": self.birth,
+                "death": self.death, "donated": self.donated,
+                "is_output": self.is_output, "tag": self.tag,
+                "aliases": self.aliases}
+
+    def __repr__(self):
+        loc = self.path or self.where
+        return (f"BufferLife({self.kind} {self.dtype}{list(self.shape)} "
+                f"{_fmt_bytes(self.nbytes)} [{loc}] "
+                f"{self.birth}..{self.death}{' ' + self.tag if self.tag else ''})")
+
+
+class MemoryTimeline:
+    """Live-set bytes per equation for one abstractly-walked step program.
+
+    All byte counts are per-device LOCAL bytes when the program was walked
+    under mesh-axis sizes (shard_lint's propagated specs divide each
+    buffer by its sharding-axis product)."""
+
+    def __init__(self, name="", sizes=None):
+        self.name = name
+        self.axis_sizes = dict(sizes or {})
+        self.buffers = []           # [BufferLife]
+        self.steps = []             # [(prim, where)]
+        self.live_bytes = []        # per-step live set after finalize
+        self.step_alloc = []        # per-step freshly-allocated bytes
+        self.peak_bytes = 0.0
+        self.peak_index = -1
+        self.peak_where = ""
+        self.peak_prim = ""
+        self.argument_bytes = 0.0
+        self.output_bytes = 0.0
+        self.const_bytes = 0.0
+        self.donated_bytes = 0.0
+        self.alias_bytes = 0.0
+
+    # -- construction (used by the walker) -----------------------------------
+    def step(self, prim, where):
+        self.steps.append((prim, where))
+        return len(self.steps) - 1
+
+    def add(self, nbytes, kind="temp", path="", where="", shape=(),
+            dtype="", donated=False, birth=-1, tag=""):
+        b = BufferLife(len(self.buffers), nbytes, kind=kind, path=path,
+                       where=where, shape=shape, dtype=dtype,
+                       donated=donated, birth=birth, tag=tag)
+        self.buffers.append(b)
+        return b.key
+
+    def use(self, key, i):
+        b = self.buffers[key]
+        if i > b.last_use:
+            b.last_use = i
+
+    @property
+    def n_steps(self):
+        return len(self.steps)
+
+    # -- liveness ------------------------------------------------------------
+    def _assign_deaths(self):
+        end = max(len(self.steps) - 1, 0)
+        for b in self.buffers:
+            if b.kind == "const" or b.is_output or \
+                    (b.kind == "input" and not b.donated):
+                b.death = end
+            elif b.kind == "input":  # donated: storage freed at last use
+                b.death = b.last_use
+            else:                    # temp: freed after its last consumer
+                b.death = max(b.last_use, b.birth)
+
+    def _match_donation_aliases(self):
+        """Donated input ↔ output storage reuse (XLA's input/output
+        aliasing): an output of identical shape+dtype+bytes born at or
+        after the donated buffer's last use takes over its storage — the
+        input stays resident to the end *as* the output, and the output
+        allocates nothing new."""
+        end = max(len(self.steps) - 1, 0)
+        outs = [b for b in self.buffers
+                if b.is_output and b.kind == "temp" and b.aliases is None]
+        donors = sorted(
+            (b for b in self.buffers if b.kind == "input" and b.donated),
+            key=lambda b: -b.nbytes)
+        for d in donors:
+            if d.is_output:
+                continue  # passed straight through: already one buffer
+            sig = (d.shape, d.dtype, d.nbytes)
+            cands = [o for o in outs
+                     if (o.shape, o.dtype, o.nbytes) == sig
+                     and o.birth >= d.last_use]
+            if not cands:
+                continue
+            o = min(cands, key=lambda o: o.birth)
+            outs.remove(o)
+            o.aliases = d.key
+            d.death = end
+            self.alias_bytes += d.nbytes
+
+    def _sweep(self, death_override=None):
+        """Event sweep → (live_bytes list, peak, peak_index)."""
+        n = len(self.steps)
+        if n == 0:
+            resident = sum(b.eff_bytes for b in self.buffers)
+            return [], resident, -1
+        delta = [0.0] * (n + 1)
+        for b in self.buffers:
+            eb = b.eff_bytes
+            if eb <= 0:
+                continue
+            death = b.death
+            if death_override and b.key in death_override:
+                death = death_override[b.key]
+            s = max(b.birth, 0)
+            if death < s:
+                continue
+            e = min(death, n - 1)
+            delta[s] += eb
+            delta[e + 1] -= eb
+        live, acc = [], 0.0
+        for i in range(n):
+            acc += delta[i]
+            live.append(acc)
+        peak_index = max(range(n), key=lambda i: live[i])
+        return live, live[peak_index], peak_index
+
+    def finalize(self):
+        self._assign_deaths()
+        self._match_donation_aliases()
+        self.live_bytes, self.peak_bytes, self.peak_index = self._sweep()
+        if 0 <= self.peak_index < len(self.steps):
+            self.peak_prim, self.peak_where = self.steps[self.peak_index]
+        self.step_alloc = [0.0] * len(self.steps)
+        for b in self.buffers:
+            if b.kind == "input":
+                self.argument_bytes += b.nbytes
+                if b.donated:
+                    self.donated_bytes += b.nbytes
+            elif b.kind == "const":
+                self.const_bytes += b.nbytes
+            if b.is_output:
+                self.output_bytes += b.nbytes
+            if 0 <= b.birth < len(self.step_alloc) and b.eff_bytes > 0:
+                self.step_alloc[b.birth] += b.eff_bytes
+        return self
+
+    # -- queries -------------------------------------------------------------
+    def contributors(self, k=None):
+        """Buffers live at the peak, largest first."""
+        if self.peak_index < 0:
+            rows = [b for b in self.buffers if b.eff_bytes > 0]
+        else:
+            rows = [b for b in self.buffers
+                    if b.eff_bytes > 0
+                    and max(b.birth, 0) <= self.peak_index <= b.death]
+        rows.sort(key=lambda b: -b.nbytes)
+        if k is not None:
+            rows = rows[:int(k)]
+        return rows
+
+    def delta_if_donated(self, paths):
+        """Predicted peak reduction (bytes freed) if the input(s) at
+        ``paths`` were donated — their lifetime shrinks to the last use
+        (no alias credit: a conservative lower bound on the win)."""
+        if isinstance(paths, str):
+            paths = (paths,)
+        targets = {p for p in paths}
+        override = {}
+        for b in self.buffers:
+            if b.kind == "input" and not b.donated and b.path in targets \
+                    and not b.is_output:
+                override[b.key] = max(b.last_use, 0)
+        if not override:
+            return 0.0
+        _, new_peak, _ = self._sweep(death_override=override)
+        return max(self.peak_bytes - new_peak, 0.0)
+
+    def long_lived(self, min_bytes, min_span):
+        """Large temporaries live across the peak for ≥ ``min_span`` of
+        the program (or tagged as scan residuals) — remat candidates."""
+        n = max(len(self.steps), 1)
+        out = []
+        for b in self.buffers:
+            if b.kind != "temp" or b.is_output or b.aliases is not None:
+                continue
+            if b.nbytes < min_bytes:
+                continue
+            s, e = max(b.birth, 0), b.death
+            if not (s <= self.peak_index <= e):
+                continue
+            span = (e - s + 1) / float(n)
+            if span >= min_span or b.tag in ("scan-ys", "residual"):
+                out.append(b)
+        out.sort(key=lambda b: -b.nbytes)
+        return out
+
+    def spikes(self, fraction, min_bytes=0):
+        """Steps whose fresh allocation is ≥ ``fraction`` of the peak."""
+        if self.peak_bytes <= 0:
+            return []
+        rows = [(i, a) for i, a in enumerate(self.step_alloc)
+                if a >= max(fraction * self.peak_bytes, min_bytes)]
+        rows.sort(key=lambda ia: -ia[1])
+        return rows
+
+    def as_dict(self, top_k=8):
+        return {
+            "name": self.name,
+            "n_steps": self.n_steps,
+            "peak_bytes": self.peak_bytes,
+            "peak_index": self.peak_index,
+            "peak_where": self.peak_where,
+            "peak_prim": self.peak_prim,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "const_bytes": self.const_bytes,
+            "donated_bytes": self.donated_bytes,
+            "alias_bytes": self.alias_bytes,
+            "axis_sizes": dict(self.axis_sizes),
+            "contributors": [b.as_dict() for b in self.contributors(top_k)],
+        }
+
+    def table(self, top_k=8):
+        lines = [f"memory timeline — {self.name or 'step'} "
+                 f"({self.n_steps} eqns"
+                 + (f", mesh {self.axis_sizes}" if self.axis_sizes else "")
+                 + ")"]
+        lines.append(f"  predicted peak {_fmt_bytes(self.peak_bytes)}"
+                     + (f" at eqn {self.peak_index} "
+                        f"[{self.peak_prim}"
+                        + (f" @ {self.peak_where}" if self.peak_where else "")
+                        + "]" if self.peak_index >= 0 else ""))
+        if self.alias_bytes:
+            lines.append(f"  donation aliasing reuses "
+                         f"{_fmt_bytes(self.alias_bytes)}")
+        rows = self.contributors(top_k)
+        if rows:
+            lines.append(f"  {'kind':<7} {'bytes':>12} {'% peak':>7}  "
+                         f"provenance")
+            peak = self.peak_bytes or 1.0
+            for b in rows:
+                loc = b.path or b.where or f"eqn {b.birth}"
+                tag = f" [{b.tag}]" if b.tag else ""
+                lines.append(f"  {b.kind:<7} {_fmt_bytes(b.nbytes):>12} "
+                             f"{100.0 * b.nbytes / peak:>6.1f}%  "
+                             f"{b.dtype}{list(b.shape)} {loc}{tag}")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (f"MemoryTimeline({self.name!r}, peak="
+                f"{_fmt_bytes(self.peak_bytes)}, eqns={self.n_steps})")
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+class _MemWalker:
+    """Linearize a jaxpr into timeline steps, tracking per-var buffer keys
+    (liveness) and per-var sharding specs (local byte counts). Spec math is
+    delegated to shard_lint's :class:`_Walker` handlers against a throwaway
+    analysis context, so both passes agree on the propagation; any
+    propagation surprise degrades to replicated — i.e. FULL logical bytes,
+    which can only over-predict (the safe direction)."""
+
+    def __init__(self, sizes, tl):
+        self.sizes = dict(sizes or {})
+        self.tl = tl
+        self._sw = shard_lint._Walker(
+            self.sizes, ShardingAnalysis(axis_order=self.sizes))
+
+    # -- var helpers ---------------------------------------------------------
+    @staticmethod
+    def _key_of(v, env):
+        if hasattr(v, "val"):  # Literal
+            return None
+        return env.get(v)
+
+    @staticmethod
+    def spec_of(v, spec_env):
+        aval = getattr(v, "aval", None)
+        ndim = len(getattr(aval, "shape", ()))
+        if hasattr(v, "val"):
+            return tuple(_R for _ in range(ndim))
+        return spec_env.get(v, tuple(_R for _ in range(ndim)))
+
+    @staticmethod
+    def _is_drop(v):
+        return type(v).__name__ == "DropVar"
+
+    def _norm(self, v, sp):
+        nd = len(getattr(v.aval, "shape", ()))
+        sp = tuple(sp)[:nd] + tuple(_R for _ in range(nd - len(sp)))
+        return _dedupe_axes(sp)
+
+    def _def_out(self, v, sp, i, where, env, spec_env, tag=""):
+        sp = self._norm(v, sp)
+        shape, dtype = _shape_dtype(v.aval)
+        key = self.tl.add(_local_bytes(v.aval, sp, self.sizes),
+                          kind="temp", where=where, shape=shape,
+                          dtype=dtype, birth=i, tag=tag)
+        if not self._is_drop(v):
+            env[v] = key
+            spec_env[v] = sp
+        return key
+
+    def _subjaxprs_of(self, eqn):
+        from .graph_lint import _subjaxprs
+
+        for v in eqn.params.values():
+            yield from _subjaxprs(v)
+
+    # -- spec propagation (mirror of _Walker.walk's dispatch, specs only) ----
+    def _out_specs(self, eqn, ins, where):
+        prim = eqn.primitive.name
+        sw = self._sw
+        try:
+            if prim == "sharding_constraint":
+                return [sw._constraint(eqn, ins[0], where, {}, 0)]
+            if prim == "dot_general":
+                return [sw._dot(eqn, ins, where, {}, 0)]
+            if prim in _REDUCE_PRIMS:
+                return [sw._reduce(eqn, ins[0], where, 0)]
+            if prim == "broadcast_in_dim":
+                return [sw._broadcast(eqn, ins[0])]
+            if prim == "transpose":
+                perm = eqn.params.get("permutation", ())
+                return [tuple(ins[0][p] for p in perm)]
+            if prim == "reshape":
+                return [sw._reshape(eqn, ins[0])]
+            if prim == "squeeze":
+                dims = set(eqn.params.get("dimensions", ()))
+                return [tuple(d for i, d in enumerate(ins[0])
+                              if i not in dims)]
+            if prim == "expand_dims":
+                dims = set(eqn.params.get("dimensions", ()))
+                nd = len(eqn.outvars[0].aval.shape)
+                it = iter(ins[0])
+                return [tuple(_R if i in dims else next(it, _R)
+                              for i in range(nd))]
+            if prim == "concatenate":
+                return [sw._concat(eqn, ins)]
+            if prim in ("dynamic_update_slice", "pad", "rev",
+                        "reduce_precision", "copy",
+                        "cumsum", "cumprod", "cummax", "cummin",
+                        "cumlogsumexp"):
+                return [ins[0]]
+            if prim in ("slice", "dynamic_slice"):
+                in_shape = eqn.invars[0].aval.shape
+                out_shape = eqn.outvars[0].aval.shape
+                return [tuple(
+                    d if int(in_shape[i]) == int(out_shape[i]) else _R
+                    for i, d in enumerate(ins[0]))]
+            return sw._generic(eqn, ins, where, {}, 0)
+        except Exception:
+            return None  # replicated fallback: over-predicts, never under
+
+    # -- the walk ------------------------------------------------------------
+    def walk(self, jaxpr, env, spec_env):
+        from .graph_lint import _eqn_where
+
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            where = _eqn_where(eqn)
+            if prim == "scan":
+                self._scan(eqn, where, env, spec_env)
+            elif prim in ("while", "cond"):
+                self._control(eqn, where, env, spec_env)
+            elif prim == "shard_map":
+                self._shard_map(eqn, where, env, spec_env)
+            elif prim in _CALL_PRIMS:
+                self._call(eqn, where, env, spec_env)
+            else:
+                self._eqn(eqn, where, env, spec_env)
+
+    def _eqn(self, eqn, where, env, spec_env, tag=""):
+        ins = [self.spec_of(v, spec_env) for v in eqn.invars]
+        i = self.tl.step(eqn.primitive.name, where)
+        for v in eqn.invars:
+            k = self._key_of(v, env)
+            if k is not None:
+                self.tl.use(k, i)
+        outs = self._out_specs(eqn, ins, where)
+        if outs is None:
+            outs = [tuple(_R for _ in getattr(v.aval, "shape", ()))
+                    for v in eqn.outvars]
+        for v, sp in zip(eqn.outvars, outs):
+            self._def_out(v, sp, i, where, env, spec_env, tag=tag)
+        return i
+
+    def _alias_in(self, sv, ov, env, spec_env):
+        k = self._key_of(ov, env)
+        if k is not None:
+            env[sv] = k
+        spec_env[sv] = self._norm(sv, self.spec_of(ov, spec_env))
+
+    def _call(self, eqn, where, env, spec_env):
+        sub = None
+        for s in self._subjaxprs_of(eqn):
+            if len(s.invars) == len(eqn.invars):
+                sub = s
+                break
+        if sub is None:  # opaque call (mismatched custom_vjp layouts etc.)
+            self._eqn(eqn, where, env, spec_env)
+            return
+        for sv, ov in zip(sub.invars, eqn.invars):
+            self._alias_in(sv, ov, env, spec_env)
+        self.walk(sub, env, spec_env)
+        i = max(len(self.tl.steps) - 1, 0)
+        for ov, sv in zip(eqn.outvars, sub.outvars):
+            k = self._key_of(sv, env)
+            if k is not None:
+                if not self._is_drop(ov):
+                    env[ov] = k
+                    spec_env[ov] = self._norm(ov, self.spec_of(sv, spec_env))
+                self.tl.use(k, i)
+            else:  # literal sub-output: materialize a tiny fresh buffer
+                self._def_out(ov, (), i, where, env, spec_env)
+
+    def _scan(self, eqn, where, env, spec_env):
+        sub = None
+        for s in self._subjaxprs_of(eqn):
+            sub = s
+            break
+        if sub is None or len(sub.invars) != len(eqn.invars):
+            self._eqn(eqn, where, env, spec_env)
+            return
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        in_keys = [self._key_of(v, env) for v in eqn.invars]
+        i0 = self.tl.step("scan", where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i0)
+                # a stacked ys from an earlier scan feeding this one is a
+                # saved residual: the fwd activation the bwd scan consumes
+                if self.tl.buffers[k].tag == "scan-ys":
+                    self.tl.buffers[k].tag = "residual"
+        for idx, sv in enumerate(sub.invars):
+            ov = eqn.invars[idx]
+            if idx < n_consts + n_carry:
+                self._alias_in(sv, ov, env, spec_env)
+            else:  # xs element slice: one loop-iteration's worth
+                osp = self.spec_of(ov, spec_env)
+                self._def_out(sv, tuple(osp[1:]), i0, where, env, spec_env,
+                              tag="scan-slice")
+        self.walk(sub, env, spec_env)
+        # exit: consts/carries/xs stay live across the whole loop, and the
+        # body's final carry/ys feed the outputs
+        i1 = self.tl.step("scan", where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i1)
+        for sv in sub.outvars:
+            k = self._key_of(sv, env)
+            if k is not None:
+                self.tl.use(k, i1)
+        for idx, ov in enumerate(eqn.outvars):
+            sv = sub.outvars[idx] if idx < len(sub.outvars) else None
+            ssp = self.spec_of(sv, spec_env) if sv is not None else ()
+            if idx < n_carry:
+                self._def_out(ov, ssp, i1, where, env, spec_env)
+            else:  # stacked ys: the FULL [length, ...] buffer lands here
+                self._def_out(ov, (_R,) + tuple(ssp), i1, where, env,
+                              spec_env, tag="scan-ys")
+
+    def _control(self, eqn, where, env, spec_env):
+        prim = eqn.primitive.name
+        sub = None
+        for s in self._subjaxprs_of(eqn):
+            sub = s
+            break
+        k_off = (len(eqn.invars) - len(sub.invars)) if sub is not None else -1
+        if sub is None or k_off < 0:
+            self._eqn(eqn, where, env, spec_env)
+            return
+        in_keys = [self._key_of(v, env) for v in eqn.invars]
+        i0 = self.tl.step(prim, where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i0)
+        for sv, ov in zip(sub.invars, eqn.invars[k_off:]):
+            self._alias_in(sv, ov, env, spec_env)
+        self.walk(sub, env, spec_env)
+        i1 = self.tl.step(prim, where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i1)
+        for sv in sub.outvars:
+            k = self._key_of(sv, env)
+            if k is not None:
+                self.tl.use(k, i1)
+        aligned = len(sub.outvars) == len(eqn.outvars)
+        for idx, ov in enumerate(eqn.outvars):
+            ssp = (self.spec_of(sub.outvars[idx], spec_env)
+                   if aligned else ())
+            self._def_out(ov, ssp, i1, where, env, spec_env)
+
+    def _shard_map(self, eqn, where, env, spec_env):
+        sub = None
+        for s in self._subjaxprs_of(eqn):
+            sub = s
+            break
+        if sub is None or len(sub.invars) != len(eqn.invars):
+            self._eqn(eqn, where, env, spec_env)
+            return
+        sizes = dict(self.sizes)
+        try:
+            sizes.update({str(k): int(v) for k, v in
+                          dict(eqn.params["mesh"].shape).items()})
+        except Exception:
+            pass
+        in_keys = [self._key_of(v, env) for v in eqn.invars]
+        i0 = self.tl.step("shard_map", where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i0)
+        # body avals are already the per-device blocks: alias the operands
+        # (their local bytes ≈ the block) and walk with replicated specs
+        for sv, ov in zip(sub.invars, eqn.invars):
+            k = self._key_of(ov, env)
+            if k is not None:
+                env[sv] = k
+            spec_env[sv] = tuple(
+                _R for _ in getattr(sv.aval, "shape", ()))
+        self.walk(sub, env, spec_env)
+        i1 = self.tl.step("shard_map", where)
+        for k in in_keys:
+            if k is not None:
+                self.tl.use(k, i1)
+        for sv in sub.outvars:
+            k = self._key_of(sv, env)
+            if k is not None:
+                self.tl.use(k, i1)
+        out_names = eqn.params.get("out_names", ()) or ()
+        for i, ov in enumerate(eqn.outvars):
+            nd = len(getattr(ov.aval, "shape", ()))
+            spec = [_R] * nd
+            if i < len(out_names):
+                try:
+                    for d, axes in dict(out_names[i]).items():
+                        if int(d) < nd:
+                            spec[int(d)] = tuple(str(a) for a in axes)
+                except Exception:
+                    pass
+            sp = self._norm(ov, tuple(spec))
+            shape, dtype = _shape_dtype(ov.aval)
+            key = self.tl.add(_local_bytes(ov.aval, sp, sizes),
+                              kind="temp", where=where, shape=shape,
+                              dtype=dtype, birth=i1)
+            if not self._is_drop(ov):
+                env[ov] = key
+                spec_env[ov] = sp
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+def timeline_from_jaxpr(closed_jaxpr, in_specs=None, axis_sizes=None,
+                        const_specs=None, donated=None, in_paths=None,
+                        out_paths=None, name=""):
+    """Liveness analysis over a raw closed jaxpr (the auto-parallel
+    planner's entry — no :class:`StepGraph` required).
+
+    Args:
+        closed_jaxpr: the traced program.
+        in_specs: per-invar PartitionSpec / axis-tuple specs (None entries
+            → replicated).
+        axis_sizes: ``{axis: size}`` mesh sizes for local byte counts.
+        const_specs: per-const specs (defaults to each const's own
+            ``.sharding`` when it carries one).
+        donated: per-invar donation flags.
+        in_paths / out_paths: provenance labels for inputs / outputs.
+
+    Returns a finalized :class:`MemoryTimeline`.
+    """
+    jaxpr = closed_jaxpr.jaxpr
+    sizes = dict(axis_sizes or {})
+    tl = MemoryTimeline(name=name, sizes=sizes)
+    walker = _MemWalker(sizes, tl)
+    env, spec_env = {}, {}
+
+    in_specs = list(in_specs or ())
+    donated = list(donated or ())
+    in_paths = list(in_paths or ())
+    for i, v in enumerate(jaxpr.invars):
+        nd = len(getattr(v.aval, "shape", ()))
+        raw = in_specs[i] if i < len(in_specs) else None
+        sp = (_dedupe_axes(_coerce_spec(raw, nd)) if raw is not None
+              else tuple(_R for _ in range(nd)))
+        shape, dtype = _shape_dtype(v.aval)
+        key = tl.add(_local_bytes(v.aval, sp, sizes), kind="input",
+                     path=(in_paths[i] if i < len(in_paths) else f"in[{i}]"),
+                     shape=shape, dtype=dtype,
+                     donated=bool(donated[i]) if i < len(donated) else False,
+                     birth=-1)
+        env[v] = key
+        spec_env[v] = sp
+
+    consts = list(getattr(closed_jaxpr, "consts", ()) or ())
+    const_specs = list(const_specs or ())
+    for i, v in enumerate(jaxpr.constvars):
+        nd = len(getattr(v.aval, "shape", ()))
+        raw = const_specs[i] if i < len(const_specs) else None
+        if raw is not None:
+            sp = _dedupe_axes(_coerce_spec(raw, nd))
+        else:
+            c = consts[i] if i < len(consts) else None
+            c = getattr(c, "_value", c)  # Tensor leaves
+            sp = spec_from_sharding(getattr(c, "sharding", None), nd)
+        shape, dtype = _shape_dtype(v.aval)
+        key = tl.add(_local_bytes(v.aval, sp, sizes), kind="const",
+                     path=f"const[{i}]", shape=shape, dtype=dtype, birth=-1)
+        env[v] = key
+        spec_env[v] = sp
+
+    walker.walk(jaxpr, env, spec_env)
+
+    out_paths = list(out_paths or ())
+    end = max(len(tl.steps) - 1, 0)
+    for i, v in enumerate(jaxpr.outvars):
+        k = _MemWalker._key_of(v, env)
+        if k is None:
+            continue
+        b = tl.buffers[k]
+        b.is_output = True
+        tl.use(k, end)
+        if not b.path and i < len(out_paths):
+            b.path = out_paths[i]
+    return tl.finalize()
+
+
+def analyze_memory(graph_or_step, *args, mesh=None, in_shardings=None,
+                   sharding=None, config=None, **kwargs):
+    """Build the :class:`MemoryTimeline` for a step.
+
+    Accepts either an already-traced :class:`~.graph_lint.StepGraph` (as
+    ``lint_step`` wires it — reusing ``graph.sharding`` for LOCAL shapes)
+    or a ``CompiledStep``/callable plus its example batch, which is traced
+    abstractly first (no device execution either way).
+    """
+    from .graph_lint import StepGraph, trace_step
+
+    if isinstance(graph_or_step, StepGraph):
+        graph = graph_or_step
+    else:
+        graph = trace_step(graph_or_step, *args, config=config, **kwargs)
+
+    sa = sharding if sharding is not None else getattr(graph, "sharding",
+                                                       None)
+    if sa is None:
+        try:
+            sa = shard_lint.analyze_sharding(
+                graph, mesh=mesh, in_shardings=in_shardings)
+        except Exception:
+            sa = None
+    sizes = dict(sa.axis_order) if sa is not None else {}
+
+    jaxpr = graph.closed_jaxpr.jaxpr
+    rows = _graph_invar_leaves(graph)
+    n_state = len(graph.state_in_paths)
+    n_don = sum(1 for _, _, d in graph.dyn_args if d)
+    flags = ([bool(graph.donate_state)] * n_state
+             + [True] * n_don
+             + [False] * (len(rows) - n_state - n_don))
+
+    in_specs, in_paths = [], []
+    for (path, leaf), v in zip(rows, jaxpr.invars):
+        nd = len(getattr(v.aval, "shape", ()))
+        sp = sa.in_specs.get(path) if sa is not None else None
+        if sp is None:
+            leaf = getattr(leaf, "_value", leaf)
+            sp = spec_from_sharding(getattr(leaf, "sharding", None), nd)
+        in_specs.append(sp)
+        in_paths.append(path)
+
+    out_paths = [p for p, _ in graph.out_paths]
+    out_paths += [p for p, _ in graph.state_out_paths]
+
+    return timeline_from_jaxpr(
+        graph.closed_jaxpr, in_specs=in_specs, axis_sizes=sizes,
+        donated=flags, in_paths=in_paths, out_paths=out_paths,
+        name=graph.name)

@@ -339,6 +339,19 @@ def _undonated(graph):
                f"alias it in-place)" if aliasable else
                f"{nbytes / 2**20:.1f} MiB held live across the step for "
                f"nothing")
+        data = {"nbytes": int(nbytes), "aliasable": bool(aliasable)}
+        # quantify the win from the liveness timeline when one is attached
+        # (lint_step wires graph.memory): predicted peak delta if donated
+        tl = getattr(graph, "memory", None)
+        if tl is not None:
+            try:
+                freed = float(tl.delta_if_donated(path))
+            except Exception:
+                freed = 0.0
+            if freed > 0:
+                data["peak_delta_bytes"] = freed
+                why += (f"; donating it is predicted to cut the peak by "
+                        f"{_fmt_mib(freed)}")
         yield Finding(
             rule="hbm-undonated-input",
             severity="warning",
@@ -348,7 +361,7 @@ def _undonated(graph):
             hint=f'CompiledStep(..., donate_inputs=["{path}"]) — only if '
                  f"the caller never reuses the batch after the call "
                  f"(io.DeviceLoader batches qualify)",
-            data={"nbytes": int(nbytes), "aliasable": bool(aliasable)},
+            data=data,
         )
 
 
@@ -641,6 +654,215 @@ def _spmd_comm_bound(graph):
         data={"comm_fraction": frac, "comm_bytes": sa.comm_bytes,
               "bytes_by_axis": per_axis},
     )
+
+
+# ---------------------------------------------------------------------------
+# HBM liveness rules (mem_lint timeline — ISSUE 12)
+# ---------------------------------------------------------------------------
+def _timeline_of(graph):
+    """The :class:`~.mem_lint.MemoryTimeline` lint_step attached (None when
+    the liveness pass failed or was skipped)."""
+    return getattr(graph, "memory", None)
+
+
+@register_rule(
+    "hbm-peak-over-capacity", "error",
+    "predicted HBM peak exceeds the device budget: the step will OOM at "
+    "dispatch")
+def _hbm_peak_over_capacity(graph):
+    """The whole point of predicting the peak: compare it against the
+    per-device HBM budget BEFORE paying for a compile (or an OOM). The
+    budget comes from ``config['hbm_capacity_bytes']`` (the CLI's
+    ``--capacity``) or the runtime's reported limit; with neither (plain
+    XLA:CPU) the rule stays silent."""
+    tl = _timeline_of(graph)
+    if tl is None or tl.peak_bytes <= 0:
+        return
+    cap = graph.config.get("hbm_capacity_bytes")
+    if not cap:
+        from .mem_lint import device_capacity_bytes
+
+        cap = device_capacity_bytes()
+    if not cap or tl.peak_bytes <= float(cap):
+        return
+    top = tl.contributors(3)
+    top_s = "; ".join(
+        f"{b.dtype}{list(b.shape)} {_fmt_mib(b.nbytes)} "
+        f"[{b.path or b.where or b.kind}]" for b in top)
+    yield Finding(
+        rule="hbm-peak-over-capacity",
+        severity="error",
+        message=f"predicted peak {_fmt_mib(tl.peak_bytes)} exceeds the "
+                f"{_fmt_mib(float(cap))} device budget "
+                f"({tl.peak_bytes / float(cap):.2f}x) — top contributors: "
+                f"{top_s}",
+        where=tl.peak_where,
+        hint="shrink the live set at the peak: donate single-use inputs, "
+             "checkpoint long-lived activations (jax.checkpoint), shard "
+             "the model further, or cut the batch/sequence",
+        data={"peak_bytes": tl.peak_bytes, "capacity_bytes": float(cap),
+              "peak_index": tl.peak_index,
+              "contributors": [b.as_dict() for b in top]},
+    )
+
+
+@register_rule(
+    "hbm-remat-candidate", "warning",
+    "large activation held live across the peak for the backward: a "
+    "jax.checkpoint boundary would trade it for recompute")
+def _hbm_remat_candidate(graph):
+    """Long-lived large temporaries alive at the peak — in a train step
+    these are the forward activations (or scan residuals) the backward
+    consumes much later. Rematerialization ('Checkpointing Beyond
+    Sqrt(N)') trades exactly these bytes for recompute FLOPs."""
+    tl = _timeline_of(graph)
+    if tl is None or tl.peak_bytes <= 0:
+        return
+    min_bytes = graph.config.get("remat_min_bytes", 8 << 20)
+    min_span = graph.config.get("remat_min_span", 0.35)
+    for b in tl.long_lived(min_bytes, min_span)[:4]:
+        span = (b.death - max(b.birth, 0) + 1) / float(max(tl.n_steps, 1))
+        what = ("scan residuals saved for the backward"
+                if b.tag in ("residual", "scan-ys")
+                else "an activation held for the backward")
+        yield Finding(
+            rule="hbm-remat-candidate",
+            severity="warning",
+            message=f"{b.dtype}{list(b.shape)} ({_fmt_mib(b.nbytes)}, "
+                    f"{100.0 * b.nbytes / tl.peak_bytes:.0f}% of peak) "
+                    f"lives across {span:.0%} of the step — {what}",
+            where=b.where,
+            hint="wrap the producing block in jax.checkpoint (a.k.a. "
+                 "jax.remat): forward recomputes it in the backward "
+                 "instead of holding it, e.g. "
+                 "`block = jax.checkpoint(block)` at the layer boundary",
+            data={"nbytes": b.nbytes, "span": span, "tag": b.tag,
+                  "birth": b.birth, "death": b.death,
+                  "peak_fraction": b.nbytes / tl.peak_bytes},
+        )
+
+
+@register_rule(
+    "hbm-liveness-spike", "warning",
+    "one equation allocates most of the peak at once: a blockwise/fused "
+    "formulation would stream it")
+def _hbm_liveness_spike(graph):
+    """A single eqn materializing ≥ ``spike_fraction`` of the peak in one
+    go (the O(seq²) attention-logits matrix is the canonical case) — the
+    blockwise/flash formulation streams it through VMEM-sized tiles
+    instead of materializing it in HBM."""
+    tl = _timeline_of(graph)
+    if tl is None or tl.peak_bytes <= 0:
+        return
+    frac = graph.config.get("spike_fraction", 0.50)
+    floor = graph.config.get("spike_min_bytes", 1 << 20)
+    spikes = tl.spikes(frac, min_bytes=floor)
+    if not spikes:
+        return
+    i, alloc = spikes[0]
+    prim, where = tl.steps[i]
+    yield Finding(
+        rule="hbm-liveness-spike",
+        severity="warning",
+        message=f"`{prim}` materializes {_fmt_mib(alloc)} in one equation "
+                f"({100.0 * alloc / tl.peak_bytes:.0f}% of the "
+                f"{_fmt_mib(tl.peak_bytes)} predicted peak)",
+        where=where,
+        hint="restructure blockwise so XLA can fuse/stream it (e.g. "
+             "flash-style attention over key blocks instead of the full "
+             "O(seq^2) logits matrix), or jnp.einsum the producer and "
+             "consumer together",
+        data={"alloc_bytes": alloc, "eqn_index": i, "prim": prim,
+              "peak_fraction": alloc / tl.peak_bytes},
+    )
+
+
+def _arg_prefix(path):
+    import re
+
+    m = re.match(r"(args\[\d+\]|kwargs\[[^\]]*\])", path or "")
+    return m.group(1) if m else None
+
+
+@register_rule(
+    "hbm-kv-bucket-waste", "warning",
+    "serving cache bucket padding wastes a large share of the cache bytes")
+def _hbm_kv_bucket_waste(graph):
+    """A donated KV-cache argument (groups of identical 4-D
+    [batch, max_len, heads, head_dim] buffers + an int32 [batch] lengths
+    vector) whose example lengths round up to prefill buckets so much that
+    ≥ ``kv_waste_fraction`` of the reserved rows are padding — shrink the
+    bucket ladder or max_len."""
+    threshold = graph.config.get("kv_waste_fraction", 0.25)
+    groups = {}
+    for path, leaf, donated in graph.dyn_args:
+        pre = _arg_prefix(path)
+        if pre is None or not donated:
+            continue
+        groups.setdefault(pre, []).append((path, leaf))
+    for pre, leaves in groups.items():
+        bufs = {}
+        lengths = None
+        for path, leaf in leaves:
+            leaf = getattr(leaf, "_value", leaf)
+            shape, dtype = _shape_dtype(leaf)
+            if len(shape) == 4:
+                bufs.setdefault((shape, dtype), []).append(path)
+            elif len(shape) == 1 and dtype in ("int32", "int64"):
+                lengths = (path, leaf)
+        if lengths is None or not bufs:
+            continue
+        (shape, dtype), paths = max(bufs.items(),
+                                    key=lambda kv: len(kv[1]))
+        if len(paths) < 2:
+            continue
+        batch, max_len = int(shape[0]), int(shape[1])
+        lpath, lleaf = lengths
+        if tuple(getattr(lleaf, "shape", ())) != (batch,):
+            continue
+        try:
+            vals = np.asarray(lleaf).astype(np.int64)
+        except Exception:
+            continue  # abstract leaf: no concrete occupancy to judge
+        active = [int(v) for v in vals if v > 0]
+        if not active:
+            continue
+        from ..serving.kv_cache import default_buckets, pick_bucket
+
+        buckets = graph.config.get("prefill_buckets") or \
+            default_buckets(max_len)
+        padded = []
+        for n in active:
+            try:
+                padded.append(pick_bucket(n, buckets))
+            except ValueError:
+                padded.append(max_len)
+        reserved = float(sum(padded))
+        waste = (reserved - sum(active)) / reserved if reserved else 0.0
+        if waste < threshold:
+            continue
+        group_bytes = sum(_nbytes(l) for _, l in leaves)
+        per_row = group_bytes / float(batch * max_len) if batch * max_len \
+            else 0.0
+        wasted_bytes = (reserved - sum(active)) * per_row
+        yield Finding(
+            rule="hbm-kv-bucket-waste",
+            severity="warning",
+            message=f"cache {pre} ({len(paths)} buffers of "
+                    f"{dtype}{list(shape)}): bucket padding wastes "
+                    f"{waste:.0%} of the reserved rows "
+                    f"(~{_fmt_mib(wasted_bytes)}) for lengths "
+                    f"{sorted(active)[:8]} under buckets "
+                    f"{list(buckets)}",
+            path=lpath,
+            hint="tighten the bucket ladder (prefill_buckets=) toward the "
+                 "observed prompt lengths, or lower max_len — every "
+                 "padded row is HBM the admission policy must reserve",
+            data={"waste_fraction": waste, "wasted_bytes": wasted_bytes,
+                  "buckets": [int(b) for b in buckets],
+                  "lengths": [int(v) for v in vals],
+                  "batch": batch, "max_len": max_len},
+        )
 
 
 # ---------------------------------------------------------------------------

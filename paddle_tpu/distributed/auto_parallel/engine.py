@@ -186,6 +186,19 @@ class Engine:
             const_names = [id2name.get(id(c)) for c in closed.consts]
             named_shapes = [(name, tuple(int(d) for d in p.shape))
                             for name, p in self.model.named_parameters()]
+            # mem-lint pruning BEFORE the comm tie-break: a candidate whose
+            # jaxpr-grounded per-device peak exceeds the chip's HBM can't
+            # run no matter how little it communicates. All-pruned (every
+            # near-tie over budget) keeps the full tie set — the analytic
+            # feasibility gate already had its say.
+            for p in ties:
+                p.predicted_peak_bytes = self._plan_peak_bytes(
+                    closed, const_names, named_shapes, planner, p)
+            fitting = [p for p in ties
+                       if not p.predicted_peak_bytes
+                       or p.predicted_peak_bytes <= planner.chip.hbm_bytes]
+            if fitting:
+                ties = fitting
             for p in ties:
                 p.predicted_comm_bytes = self._plan_comm_bytes(
                     closed, const_names, named_shapes, planner, p)
@@ -234,6 +247,48 @@ class Engine:
         if plan.sharding > 1:
             comm += 3.0 * (plan.sharding - 1) / plan.sharding * pbytes
         return comm
+
+    def _plan_peak_bytes(self, closed, const_names, named_shapes, planner,
+                         plan):
+        """Predicted per-device HBM peak for one candidate: the mem-lint
+        liveness timeline over the forward jaxpr with the candidate's
+        placements (per-shard local shapes), plus one gradient copy of the
+        local parameters. A lower bound on the full train-step peak (the
+        backward's activation liveness isn't traced here), so it only
+        prunes placements that are over budget on the forward alone —
+        exactly the clearly-infeasible ones. 0.0 on any failure (keeps
+        the candidate)."""
+        try:
+            from ...analysis import mem_lint, shard_lint
+
+            data_ways = max(plan.dp * plan.sharding, 1)
+            sizes = {"dp": data_ways, "mp": plan.mp}
+            placements = (planner.param_placements(named_shapes, plan)
+                          if plan.mp > 1 else {})
+            const_specs = []
+            for name, c in zip(const_names, closed.consts):
+                nd = len(tuple(getattr(c, "shape", ())))
+                spec = placements.get(name) if name else None
+                if spec and any(s is not None for s in spec):
+                    const_specs.append(shard_lint._coerce_spec(spec, nd))
+                else:
+                    const_specs.append(tuple(() for _ in range(nd)))
+            in_specs = []
+            for v in closed.jaxpr.invars:
+                shape = tuple(getattr(v.aval, "shape", ()))
+                sp = [()] * len(shape)
+                if (shape and data_ways > 1
+                        and int(shape[0]) % data_ways == 0):
+                    sp[0] = ("dp",)
+                in_specs.append(tuple(sp))
+            tl = mem_lint.timeline_from_jaxpr(
+                closed, in_specs=in_specs, axis_sizes=sizes,
+                const_specs=const_specs, name="plan_fwd")
+            grad_bytes = sum(4.0 * float(np.prod(s) if s else 1)
+                             for _, s in named_shapes) / max(plan.mp, 1)
+            return float(tl.peak_bytes) + grad_bytes
+        except Exception:  # noqa: BLE001 - pruning is best-effort
+            return 0.0
 
     # -- strategy ------------------------------------------------------------
     def _apply_strategy(self):

@@ -62,6 +62,11 @@ class Plan:
     # placements (filled by Engine._break_plan_tie when candidates tie on
     # the analytic estimate; 0.0 = not ranked)
     predicted_comm_bytes: float = 0.0
+    # mem-lint predicted per-device HBM peak for this plan's placements
+    # over the model's real forward jaxpr (filled alongside
+    # predicted_comm_bytes; candidates over the chip's HBM are pruned
+    # before the comm tie-break; 0.0 = not ranked)
+    predicted_peak_bytes: float = 0.0
 
     @property
     def degrees(self):

@@ -121,18 +121,28 @@ class MemoryBreakdown:
 
     ``peak_bytes = argument + output + temp + generated_code − alias``:
     the alias term is the donated argument bytes whose buffers the outputs
-    reuse (counted once, not twice)."""
+    reuse (counted once, not twice).
+
+    ``alias_unavailable=True`` marks a breakdown whose alias term could not
+    be trusted: an executable deserialized from the persistent compilation
+    cache reports ``alias_size_in_bytes=0`` even when donation aliases
+    buffers (observed on XLA:CPU), so ``peak_bytes`` double-counts the
+    donated arguments. Consumers that *gate* on the peak
+    (``analysis.crosscheck_mem``, ``tools/mem_report``) skip or annotate
+    such a breakdown instead of mis-gating on it."""
 
     __slots__ = ("argument_bytes", "output_bytes", "temp_bytes",
-                 "generated_code_bytes", "alias_bytes")
+                 "generated_code_bytes", "alias_bytes", "alias_unavailable")
 
     def __init__(self, argument_bytes=0, output_bytes=0, temp_bytes=0,
-                 generated_code_bytes=0, alias_bytes=0):
+                 generated_code_bytes=0, alias_bytes=0,
+                 alias_unavailable=False):
         self.argument_bytes = int(argument_bytes)
         self.output_bytes = int(output_bytes)
         self.temp_bytes = int(temp_bytes)
         self.generated_code_bytes = int(generated_code_bytes)
         self.alias_bytes = int(alias_bytes)
+        self.alias_unavailable = bool(alias_unavailable)
 
     @property
     def peak_bytes(self):
@@ -169,6 +179,7 @@ class MemoryBreakdown:
             "generated_code_bytes": self.generated_code_bytes,
             "alias_bytes": self.alias_bytes,
             "peak_bytes": self.peak_bytes,
+            "alias_unavailable": self.alias_unavailable,
         }
 
     def __repr__(self):
@@ -176,7 +187,9 @@ class MemoryBreakdown:
                 f"arg={self.argument_bytes}, out={self.output_bytes}, "
                 f"temp={self.temp_bytes}, "
                 f"code={self.generated_code_bytes}, "
-                f"alias={self.alias_bytes})")
+                f"alias={self.alias_bytes}"
+                + (", alias_unavailable" if self.alias_unavailable else "")
+                + ")")
 
 
 # ---------------------------------------------------------------------------
@@ -537,6 +550,7 @@ class DeviceCostReport:
             md = self.memory.as_dict()
             peak = md.pop("peak_bytes") or 1
             alias = md.pop("alias_bytes")
+            alias_unavailable = md.pop("alias_unavailable", False)
             lines.append(f"  hbm peak       {_fmt_bytes(peak)}")
             for k, v in sorted(md.items(), key=lambda kv: -kv[1]):
                 if v:
@@ -545,6 +559,9 @@ class DeviceCostReport:
             if alias:
                 lines.append(f"    {'alias_bytes (reused)':<22} "
                              f"{'-' + _fmt_bytes(alias):>12}")
+            if alias_unavailable:
+                lines.append("    alias term unavailable (persistent-cache "
+                             "executable): peak over-counts donated args")
         if self.collectives:
             lines.append(f"  collectives ({self.comm_source}): "
                          f"{_fmt_bytes(self.comm_bytes)} moved/device, "
@@ -717,6 +734,13 @@ def device_report(step, *args, mesh=None, name=None, register=None, **kwargs):
     lowered = _lower_isolated(step, sds_args, sds_kwargs)
     compiled = lowered.compile()
     memory = MemoryBreakdown.from_compiled(compiled)
+    if (memory is not None and memory.alias_bytes == 0
+            and (getattr(step, "donate_state", False)
+                 or getattr(step, "donate_inputs", False))):
+        # the step donates buffers, yet the executable reports zero alias
+        # bytes: the persistent-cache deserialization path loses the alias
+        # table (XLA:CPU) — flag it so peak-gating consumers skip this one
+        memory.alias_unavailable = True
     try:
         cost = normalize_cost_analysis(compiled.cost_analysis())
     except Exception:
@@ -901,7 +925,8 @@ class OOMForensics:
                 output_bytes=mem.get("output_bytes", 0),
                 temp_bytes=mem.get("temp_bytes", 0),
                 generated_code_bytes=mem.get("generated_code_bytes", 0),
-                alias_bytes=mem.get("alias_bytes", 0))
+                alias_bytes=mem.get("alias_bytes", 0),
+                alias_unavailable=mem.get("alias_unavailable", False))
         return cls(d.get("step", "?"), d.get("error", ""), memory=mem,
                    donation=d.get("donation"), batch=d.get("batch"),
                    state=d.get("state"), collectives=d.get("collectives"))
@@ -914,6 +939,7 @@ class OOMForensics:
             md = self.memory.as_dict()
             peak = md.pop("peak_bytes") or 1
             alias = md.pop("alias_bytes")
+            md.pop("alias_unavailable", None)
             lines.append(f"  compiled memory breakdown "
                          f"(peak {_fmt_bytes(peak)}):")
             for k, v in sorted(md.items(), key=lambda kv: -kv[1]):

@@ -106,6 +106,7 @@ class GenerationEngine:
         if freeze_weights == "auto":
             freeze_weights = jax.default_backend() == "cpu"
         self.freeze_weights = bool(freeze_weights)
+        self._footprints = None  # predicted_footprints() cache
         stateful = [] if self.freeze_weights else [model]
         self._prefill_step = CompiledStep(
             self._make_prefill(), stateful=stateful, donate_state=True,
@@ -241,6 +242,55 @@ class GenerationEngine:
     def lengths(self):
         """Per-slot cached-token counts (host numpy)."""
         return np.asarray(_leaf(self.cache.lengths))
+
+    def predicted_footprints(self, refresh=False):
+        """Predicted HBM footprints of this engine's serving programs,
+        from the static memory-lint timeline (``analysis.analyze_memory``
+        over the decode step — abstract, no device execution). Cached
+        after the first call; ``refresh=True`` re-derives.
+
+        Returns a dict:
+
+        * ``decode_peak_bytes`` — predicted live-set peak of one batched
+          ``serve_decode`` dispatch (cache + weights + activations);
+        * ``cache_bytes`` — the static KV cache allocation;
+        * ``base_bytes`` — everything but the cache (weights, decode
+          temps): resident whether or not any request is active;
+        * ``per_token_bytes`` — KV bytes one cached token pins across
+          all layers;
+        * ``prefill_bucket_bytes`` — per-bucket KV bytes a request
+          padded to that bucket pins at admit.
+
+        When the abstract timeline is unavailable (lint failure),
+        ``decode_peak_bytes`` falls back to plain cache arithmetic
+        (``2 × cache_bytes`` — donation holds old+new cache at the swap)
+        and ``timeline`` is None; the byte-based admission policy stays
+        usable either way."""
+        if self._footprints is not None and not refresh:
+            return dict(self._footprints)
+        cache_bytes = int(self.cache.nbytes())
+        per_token = max(1, cache_bytes // (self.max_batch * self.max_len))
+        timeline = None
+        try:
+            from .. import analysis
+
+            tokens, cache = self.example_decode_args([1])
+            timeline = analysis.analyze_memory(
+                self._decode_step, tokens, cache)
+            decode_peak = float(timeline.peak_bytes)
+        except Exception:  # noqa: BLE001 - advisory: fall back to arithmetic
+            decode_peak = float(2 * cache_bytes)
+        self._footprints = {
+            "decode_peak_bytes": decode_peak,
+            "cache_bytes": float(cache_bytes),
+            "base_bytes": max(0.0, decode_peak - cache_bytes),
+            "per_token_bytes": float(per_token),
+            "prefill_bucket_bytes": {
+                int(b): float(per_token * min(self.max_len, int(b)))
+                for b in self.prefill_buckets},
+            "timeline": timeline,
+        }
+        return dict(self._footprints)
 
     @property
     def decode_step(self):

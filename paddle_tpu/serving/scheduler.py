@@ -180,26 +180,78 @@ class Request:
 
 class CostAwareAdmission:
     """Optional admission policy: shed when the estimated outstanding work
-    would exceed a token budget.
+    would exceed a budget.
 
-    A request's cost estimate is its padded prefill bucket plus its decode
-    budget (``pick_bucket(len(prompt)) + max_new_tokens`` — the slot-steps
-    it will consume). The backlog is the summed estimate over the queue
-    plus the REMAINING budget of every active request. Admission requires
+    ``policy="tokens"`` (default, the PR 10 behavior): a request's cost is
+    its padded prefill bucket plus its decode budget
+    (``pick_bucket(len(prompt)) + max_new_tokens`` — the slot-steps it
+    will consume). The backlog is the summed estimate over the queue plus
+    the REMAINING budget of every active request. Admission requires
     ``backlog + cost(request) <= max_backlog_tokens``; the default cap is
     ``headroom × max_batch × max_len`` — roughly ``headroom`` batches'
-    worth of full-capacity work. Deterministic by construction (pure
-    arithmetic over the scheduler's state)."""
+    worth of full-capacity work.
 
-    def __init__(self, max_backlog_tokens=None, headroom=2.0):
+    ``policy="bytes"``: the same backlog arithmetic, measured in
+    *predicted HBM bytes* from the engine's static memory-lint timeline
+    (``engine.predicted_footprints()``): a request pins
+    ``per_token_bytes × min(max_len, bucket + max_new_tokens)`` of KV
+    cache, on top of the engine's resident ``base_bytes`` (weights +
+    decode activations). Admission requires ``base_bytes + backlog_bytes
+    + cost_bytes(request) <= capacity_bytes``; the default capacity is
+    the detected device HBM budget
+    (:func:`paddle_tpu.analysis.mem_lint.device_capacity_bytes`), falling
+    back to ``base_bytes + headroom × cache_bytes``. Shedding at submit on
+    a byte budget makes the OOM-safe degraded decode path (evict victims
+    mid-tick, retry at reduced batch) the LAST resort instead of the
+    first line of defense.
+
+    Both policies are deterministic by construction (pure arithmetic over
+    the scheduler's state)."""
+
+    def __init__(self, max_backlog_tokens=None, headroom=2.0,
+                 policy="tokens", capacity_bytes=None):
+        if policy not in ("tokens", "bytes"):
+            raise ValueError(f"policy must be 'tokens' or 'bytes', "
+                             f"got {policy!r}")
         self.max_backlog_tokens = max_backlog_tokens
         self.headroom = float(headroom)
+        self.policy = policy
+        self.capacity_bytes = capacity_bytes
 
     def estimate(self, request, engine):
         bucket = pick_bucket(len(request.prompt), engine.prefill_buckets)
         return bucket + int(request.max_new_tokens)
 
+    def estimate_bytes(self, request, engine):
+        """Predicted KV bytes this request pins until it finishes: its
+        padded bucket plus decode budget, clamped to the cache capacity,
+        priced at the engine's per-token KV footprint."""
+        fp = engine.predicted_footprints()
+        tokens = min(int(engine.max_len), self.estimate(request, engine))
+        return fp["per_token_bytes"] * tokens
+
+    def _admit_bytes(self, request, scheduler):
+        eng = scheduler.engine
+        fp = eng.predicted_footprints()
+        cap = self.capacity_bytes
+        if cap is None:
+            from ..analysis.mem_lint import device_capacity_bytes
+
+            cap = device_capacity_bytes()
+        if cap is None:
+            cap = fp["base_bytes"] + self.headroom * fp["cache_bytes"]
+        per_tok = fp["per_token_bytes"]
+        backlog = sum(self.estimate_bytes(q, eng) for q in scheduler.queue)
+        backlog += sum(
+            per_tok * min(int(eng.max_len),
+                          len(r.prompt) + int(r.max_new_tokens))
+            for r in scheduler.active.values())
+        need = fp["base_bytes"] + backlog + self.estimate_bytes(request, eng)
+        return need <= float(cap)
+
     def __call__(self, request, scheduler):
+        if self.policy == "bytes":
+            return self._admit_bytes(request, scheduler)
         eng = scheduler.engine
         cap = self.max_backlog_tokens
         if cap is None:

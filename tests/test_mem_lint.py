@@ -1,0 +1,528 @@
+"""Memory lint (ISSUE 12): per-eqn liveness over the step jaxpr, the
+hbm-* registry rules, the predicted-vs-measured peak crosscheck on the
+MULTICHIP zoo + serve decode, donation-aliasing / scan-residual liveness,
+the bytes-based admission policy, the auto-parallel peak pruning, and the
+CLI exports.
+
+Acceptance (ISSUE 12):
+  * on the dp×mp zoo config and the gpt2 serve decode the predicted peak
+    agrees with ``compiled.memory_analysis()`` within rtol=0.15 on
+    XLA:CPU and never UNDER-predicts beyond the rtol;
+  * ``tools/mem_lint.py --fixture undonated-longctx`` exits 1;
+  * the bytes-based ``CostAwareAdmission`` sheds a request at submit that
+    the token-count policy would have admitted straight into an
+    injected-OOM degraded-decode tick.
+"""
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import mem_lint
+from paddle_tpu.fault import inject
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.profiler import devprof, telemetry
+from paddle_tpu.serving import (
+    CostAwareAdmission,
+    GenerationEngine,
+    Request,
+    Scheduler,
+)
+from paddle_tpu.utils import unique_name
+
+needs_8_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual CPU mesh")
+
+
+def _load_cli():
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "mem_lint.py")
+    spec = importlib.util.spec_from_file_location("mem_lint_cli", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cli():
+    return _load_cli()
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    telemetry.disable()
+    telemetry.reset()
+    devprof.clear_reports()
+    inject.disarm_all()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    devprof.clear_reports()
+    inject.disarm_all()
+
+
+def _mlp(donate=True, batch=16, din=32, dh=64):
+    """Tiny single-device MLP train step for the liveness unit tests."""
+    with unique_name.guard():
+        paddle.seed(0)
+        l1 = paddle.nn.Linear(din, dh)
+        l2 = paddle.nn.Linear(dh, din)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=list(l1.parameters()) + list(l2.parameters()))
+
+    def train_step(x, y):
+        h = paddle.nn.functional.relu(l1(x))
+        out = l2(h)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "mlp_train_step"
+    step = CompiledStep(train_step, stateful=[l1, l2, opt],
+                        donate_state=donate)
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.randn(batch, din).astype(np.float32))
+    y = Tensor(rng.randn(batch, din).astype(np.float32))
+    return step, (x, y)
+
+
+@pytest.fixture(scope="module")
+def serve_eng():
+    """One warmed 2-slot engine shared by the serving-side tests (same
+    sharing rationale as test_serving_resilience: prefill fully resets a
+    slot on admit, so state cannot leak between tests)."""
+    with unique_name.guard():
+        paddle.seed(3)
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=64, hidden_dropout=0.0,
+            attention_dropout=0.0))
+    model.eval()
+    e = GenerationEngine(model, max_batch=2, max_len=64,
+                         prefill_buckets=(8, 16))
+    e.prefill(0, [1] * 7)
+    e.decode_once(np.zeros(2, np.int32))
+    return e
+
+
+def _sched(eng, **kw):
+    kw.setdefault("retry_sleep", lambda s: None)
+    return Scheduler(eng, **kw)
+
+
+def _reqs(n, seed=5, max_new=6, vocab=97):
+    rng = np.random.RandomState(seed)
+    return [Request(prompt=rng.randint(0, vocab,
+                                       int(rng.randint(3, 14))).tolist(),
+                    max_new_tokens=max_new) for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# acceptance: predicted vs measured peak on the zoo configs
+# ---------------------------------------------------------------------------
+
+def _cli_measure(model):
+    """Drive the measured crosscheck in a SUBPROCESS: the rtol gate needs
+    a real alias term, and an executable deserialized from the persistent
+    compile cache (tests/conftest.py enables it for this process) reports
+    alias=0 — tripping satellite 1's alias_unavailable skip, which would
+    pass the gate vacuously on every warm run. The CLI process never
+    enables the persistent cache, so its compile is always fresh —
+    without toggling global jax config inside this process."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "mem_lint.py")
+    return subprocess.run(
+        [sys.executable, path, "--models", model, "--measure"],
+        capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+@needs_8_devices
+def test_crosscheck_dp_mp_zoo(cli):
+    """dp×mp Megatron-TP MLP with donated state: the timeline's peak
+    (donation aliasing + per-shard local shapes) agrees with XLA's
+    ``memory_analysis()`` within rtol and never under-predicts."""
+    buf = io.StringIO()
+    (name, report, tl, rows), = cli.lint_zoo(["dp-mp"], out=buf)
+    assert tl is not None and tl.peak_bytes > 0
+    assert tl.alias_bytes > 0, "donated state must alias into the outputs"
+    out = _cli_measure("dp-mp")
+    assert out.returncode == 0, out.stdout + out.stderr
+    checks = [l for l in out.stdout.splitlines()
+              if l.startswith("crosscheck:")]
+    assert checks, out.stdout
+    for line in checks:
+        assert "agrees=True" in line and "under_predicted=False" in line, \
+            line
+    assert "0 crosscheck disagreement(s)" in out.stdout
+
+
+def test_crosscheck_serve_decode_zoo(cli):
+    """gpt2-style serve decode: the static-shape KV-cache step's predicted
+    peak agrees with the measured one, and the padded example lengths
+    trip hbm-kv-bucket-waste."""
+    buf = io.StringIO()
+    (name, report, tl, rows), = cli.lint_zoo(["serve-decode"], out=buf)
+    assert tl is not None and tl.peak_bytes > 0
+    # lengths [3, 5] against the default bucket ladder waste >25%
+    assert report.by_rule("hbm-kv-bucket-waste")
+    out = _cli_measure("serve-decode")
+    assert out.returncode == 0, out.stdout + out.stderr
+    checks = [l for l in out.stdout.splitlines()
+              if l.startswith("crosscheck:")]
+    assert checks, out.stdout
+    for line in checks:
+        assert "agrees=True" in line and "under_predicted=False" in line, \
+            line
+
+
+# ---------------------------------------------------------------------------
+# rules: positive + clean per rule
+# ---------------------------------------------------------------------------
+
+def test_rule_peak_over_capacity():
+    step, (x, y) = _mlp()
+    rep = analysis.lint_step(step, x, y,
+                             config={"hbm_capacity_bytes": 256.0})
+    hits = rep.by_rule("hbm-peak-over-capacity")
+    assert hits and hits[0].severity == "error"
+    assert "exceeds" in hits[0].message
+    clean = analysis.lint_step(step, x, y,
+                               config={"hbm_capacity_bytes": float(1 << 40)})
+    assert not clean.by_rule("hbm-peak-over-capacity")
+
+
+def test_rule_remat_candidate():
+    step, (x, y) = _mlp()
+    rep = analysis.lint_step(step, x, y,
+                             config={"remat_min_bytes": 1.0,
+                                     "remat_min_span": 0.0})
+    hits = rep.by_rule("hbm-remat-candidate")
+    assert hits and hits[0].severity == "warning"
+    assert "jax.checkpoint" in hits[0].hint
+    clean = analysis.lint_step(step, x, y)  # default 8 MiB floor
+    assert not clean.by_rule("hbm-remat-candidate")
+
+
+def test_rule_liveness_spike():
+    step, (x, y) = _mlp()
+    rep = analysis.lint_step(step, x, y,
+                             config={"spike_min_bytes": 1.0,
+                                     "spike_fraction": 0.01})
+    hits = rep.by_rule("hbm-liveness-spike")
+    assert hits and hits[0].severity == "warning"
+    clean = analysis.lint_step(step, x, y,
+                               config={"spike_min_bytes": float(1 << 40)})
+    assert not clean.by_rule("hbm-liveness-spike")
+
+
+def test_rule_kv_bucket_waste(serve_eng):
+    tokens, cache = serve_eng.example_decode_args([1])
+    rep = analysis.lint_step(serve_eng.decode_step, tokens, cache)
+    hits = rep.by_rule("hbm-kv-bucket-waste")
+    assert hits and hits[0].severity == "warning"
+    assert "wastes" in hits[0].message
+    # near-full occupancy: 60/64 rounds to the top bucket with ~6% waste
+    tokens, cache = serve_eng.example_decode_args([60, 60])
+    clean = analysis.lint_step(serve_eng.decode_step, tokens, cache)
+    assert not clean.by_rule("hbm-kv-bucket-waste")
+
+
+def test_undonated_input_reports_peak_delta():
+    """Satellite: hbm-undonated-input now quotes the timeline's predicted
+    peak reduction for donating the flagged inputs."""
+    step, (x, y) = _mlp(donate=False)
+    rep = analysis.lint_step(step, x, y,
+                             config={"donate_min_bytes": 1.0})
+    hits = rep.by_rule("hbm-undonated-input")
+    assert hits
+    assert any("peak" in f.message for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# liveness mechanics: donation aliasing + scan residual attribution
+# ---------------------------------------------------------------------------
+
+def test_donation_aliasing_liveness():
+    stepd, (xd, yd) = _mlp(donate=True)
+    stepu, (xu, yu) = _mlp(donate=False)
+    tld = analysis.analyze_memory(stepd, xd, yd)
+    tlu = analysis.analyze_memory(stepu, xu, yu)
+    # donated run: updated state aliases the donated buffers — the alias
+    # term is positive and the aliased outputs stop double-counting
+    assert tld.alias_bytes > 0
+    assert any(b.is_output and b.aliases is not None and b.eff_bytes == 0
+               for b in tld.buffers)
+    assert any(b.donated for b in tld.buffers)
+    # undonated run: no aliasing, and the peak can only be higher
+    assert tlu.alias_bytes == 0
+    assert tlu.peak_bytes >= tld.peak_bytes
+    # what-if: donating the undonated state shrinks the predicted peak
+    paths = [b.path for b in tlu.buffers
+             if b.kind == "input" and not b.donated and b.path]
+    assert tlu.delta_if_donated(paths) > 0
+
+
+def test_scan_residual_attribution():
+    """grad-of-scan: the forward scan's stacked ys consumed by the
+    backward scan are tagged as residuals and qualify as remat
+    candidates regardless of span."""
+    W = jnp.eye(16, dtype=jnp.float32)
+    xs = jnp.ones((8, 16), jnp.float32)
+
+    def loss(W, xs):
+        def body(c, x):
+            c = jnp.tanh(c @ W) + x
+            return c, c
+
+        _, ys = jax.lax.scan(body, jnp.zeros(16, jnp.float32), xs)
+        return ys.sum()
+
+    closed = jax.make_jaxpr(jax.grad(loss))(W, xs)
+    tl = mem_lint.timeline_from_jaxpr(closed, name="scan-grad")
+    tags = {b.tag for b in tl.buffers if b.tag}
+    assert tags & {"residual", "scan-ys"}, tags
+    # residual tags qualify for remat independently of the span filter
+    remat = tl.long_lived(1.0, 1.1)
+    assert any(b.tag in ("residual", "scan-ys") for b in remat)
+
+
+def test_timeline_table_and_dict():
+    step, (x, y) = _mlp()
+    tl = analysis.analyze_memory(step, x, y)
+    d = tl.as_dict(top_k=3)
+    assert d["peak_bytes"] == tl.peak_bytes
+    assert len(d["contributors"]) <= 3
+    assert "peak" in tl.table()
+
+
+# ---------------------------------------------------------------------------
+# crosscheck_mem unit semantics
+# ---------------------------------------------------------------------------
+
+def test_crosscheck_mem_verdicts():
+    ok = analysis.crosscheck_mem(100.0, {"peak_bytes": 100.0})[0]
+    assert ok["agrees"] is True and ok["under_predicted"] is False
+    under = analysis.crosscheck_mem(50.0, {"peak_bytes": 100.0})[0]
+    assert under["agrees"] is False and under["under_predicted"] is True
+    over = analysis.crosscheck_mem(200.0, {"peak_bytes": 100.0})[0]
+    assert over["agrees"] is False and over["under_predicted"] is False
+
+
+def test_crosscheck_mem_skips_alias_unavailable():
+    """Satellite: a persistent-cache executable's MemoryBreakdown
+    (alias term unavailable) must be skipped, not mis-gated."""
+    mb = devprof.MemoryBreakdown(argument_bytes=100, output_bytes=50,
+                                 alias_bytes=0, alias_unavailable=True)
+    assert mb.as_dict()["alias_unavailable"] is True
+    row = analysis.crosscheck_mem(100.0, mb)[0]
+    assert row["skipped"]
+    assert row["agrees"] is None
+    # the dict form (e.g. a registered report round-tripped via JSON)
+    # skips identically
+    row2 = analysis.crosscheck_mem(
+        100.0, {"peak_bytes": 150.0, "alias_unavailable": True})[0]
+    assert row2["skipped"] and row2["agrees"] is None
+
+
+# ---------------------------------------------------------------------------
+# serving: predicted footprints + bytes-based admission
+# ---------------------------------------------------------------------------
+
+def test_predicted_footprints(serve_eng):
+    fp = serve_eng.predicted_footprints()
+    for key in ("decode_peak_bytes", "cache_bytes", "base_bytes",
+                "per_token_bytes", "prefill_bucket_bytes", "timeline"):
+        assert key in fp, key
+    assert fp["cache_bytes"] > 0
+    assert fp["per_token_bytes"] >= 1
+    assert fp["base_bytes"] >= 0
+    assert fp["decode_peak_bytes"] > 0
+    assert set(fp["prefill_bucket_bytes"]) == set(serve_eng.prefill_buckets)
+    for b, nbytes in fp["prefill_bucket_bytes"].items():
+        assert nbytes == fp["per_token_bytes"] * min(serve_eng.max_len, b)
+    # cached until refresh=True
+    assert serve_eng.predicted_footprints()["decode_peak_bytes"] == \
+        fp["decode_peak_bytes"]
+    fresh = serve_eng.predicted_footprints(refresh=True)
+    assert fresh["cache_bytes"] == fp["cache_bytes"]
+
+
+def test_admission_policy_validation():
+    with pytest.raises(ValueError):
+        CostAwareAdmission(policy="flops")
+
+
+def test_bytes_admission_sheds_before_injected_oom(serve_eng):
+    """Acceptance: capacity the token policy can't see. The token-count
+    policy admits both requests and an injected OOM mid-decode forces a
+    degraded-decode eviction; the bytes policy, fed the predicted
+    per-bucket footprints against the same capacity, sheds the second
+    request at submit — degraded decode becomes the last resort."""
+    eng = serve_eng
+    fp = eng.predicted_footprints()
+    prompts = [r.prompt for r in _reqs(2, seed=11)]
+
+    # token policy: backlog bound is generous, both admitted
+    tok = _sched(eng, admission=CostAwareAdmission(
+        max_backlog_tokens=10 ** 9))
+    tok_reqs = [Request(prompt=list(p), max_new_tokens=6) for p in prompts]
+    for r in tok_reqs:
+        tok.submit(r)
+    assert all(r.finish_reason != "shed" for r in tok_reqs)
+    inject.arm("oom", "serve.decode", at=2)
+    tok.run()
+    assert sum(r.finish_reason == "oom_evicted" for r in tok_reqs) == 1
+
+    # bytes policy against a capacity that fits exactly one request:
+    # the same second request is shed at submit instead of being
+    # admitted into the OOM
+    probe = CostAwareAdmission(policy="bytes")
+    costs = [probe.estimate_bytes(
+        Request(prompt=list(p), max_new_tokens=6), eng) for p in prompts]
+    cap = fp["base_bytes"] + costs[0] + 0.5 * costs[1]
+    by = _sched(eng, admission=CostAwareAdmission(
+        policy="bytes", capacity_bytes=cap))
+    by_reqs = [Request(prompt=list(p), max_new_tokens=6) for p in prompts]
+    by.submit(by_reqs[0])
+    assert by_reqs[0].finish_reason is None, "first request must fit"
+    by.submit(by_reqs[1])
+    assert by_reqs[1].finish_reason == "shed"
+    by.run()
+    assert by_reqs[0].finish_reason in ("eos", "length")
+
+
+# ---------------------------------------------------------------------------
+# auto-parallel: peak-aware plan pruning
+# ---------------------------------------------------------------------------
+
+def _tie_setup():
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed.auto_parallel.planner import Plan, Planner
+
+    with unique_name.guard():
+        paddle.seed(0)
+        net = paddle.nn.Linear(32, 32)
+    eng = Engine.__new__(Engine)  # wiring-only: no mesh/fit needed
+    eng.model = net
+
+    def fwd_loss(xa, ya):
+        out = net(Tensor(xa))
+        return (((out - Tensor(ya)) ** 2).mean())._value
+
+    x = Tensor(np.random.RandomState(0).randn(16, 32).astype(np.float32))
+    y = Tensor(np.random.RandomState(1).randn(16, 32).astype(np.float32))
+    stats = {"step_flops": 1e6, "param_bytes": 32 * 32 * 4,
+             "act_bytes": 16 * 32 * 4, "layers": 1, "batch": 16,
+             "param_shapes": [(32 * 32 * 4, (32, 32))]}
+
+    def planner_for(tied):
+        class _TiedPlanner(Planner):
+            def enumerate_plans(self):
+                return list(tied)
+
+        return _TiedPlanner(8, stats)
+
+    def plans():
+        return [Plan(dp=8, mp=1, est_step_time=1.0, feasible=True),
+                Plan(dp=4, mp=2, est_step_time=1.0, feasible=True)]
+
+    return eng, fwd_loss, x, y, planner_for, plans
+
+
+@needs_8_devices
+def test_plan_tie_break_scores_predicted_peak():
+    """Every tied candidate gets a mem-lint predicted peak; with the
+    default 16 GB chip nothing is pruned and the comm winner stands."""
+    eng, fwd_loss, x, y, planner_for, plans = _tie_setup()
+    tied = plans()
+    chosen = eng._break_plan_tie(planner_for(tied), tied[0], fwd_loss, x, y)
+    assert all(p.predicted_peak_bytes > 0 for p in tied)
+    assert chosen is min(tied, key=lambda p: p.predicted_comm_bytes)
+
+
+@needs_8_devices
+def test_plan_prune_over_capacity():
+    """A tied candidate whose predicted peak exceeds the chip's HBM is
+    pruned before the comm tie-break — and when EVERY candidate is over,
+    pruning backs off instead of discarding them all."""
+    eng, fwd_loss, x, y, planner_for, plans = _tie_setup()
+    # pass 1: score both peaks under the default (huge) capacity
+    scored = plans()
+    eng._break_plan_tie(planner_for(scored), scored[0], fwd_loss, x, y)
+    peaks = sorted(p.predicted_peak_bytes for p in scored)
+    assert peaks[0] > 0 and peaks[0] < peaks[1], peaks
+
+    # capacity between the two peaks: the bigger plan is pruned, the
+    # smaller one wins even if it lost the comm tie-break
+    tied = plans()
+    planner = planner_for(tied)
+    planner.chip.hbm_bytes = 0.5 * (peaks[0] + peaks[1])
+    chosen = eng._break_plan_tie(planner, tied[0], fwd_loss, x, y)
+    assert chosen.predicted_peak_bytes == pytest.approx(peaks[0])
+
+    # capacity below both: all pruned -> keep all, comm winner stands
+    tied2 = plans()
+    planner2 = planner_for(tied2)
+    planner2.chip.hbm_bytes = 1.0
+    chosen2 = eng._break_plan_tie(planner2, tied2[0], fwd_loss, x, y)
+    assert chosen2 is min(tied2, key=lambda p: p.predicted_comm_bytes)
+
+
+# ---------------------------------------------------------------------------
+# CLI: fixture gate, SARIF/JSONL exports, bench-sentinel satellite
+# ---------------------------------------------------------------------------
+
+def test_cli_fixture_exits_nonzero(cli, capsys, tmp_path):
+    """Acceptance: the undonated long-context fixture must exit 1 —
+    peak over the injected budget + the undonated-input delta."""
+    out_jsonl = tmp_path / "findings.jsonl"
+    rc = cli.run(["--fixture", "undonated-longctx",
+                  "--jsonl", str(out_jsonl)])
+    assert rc == 1
+    text = capsys.readouterr().out
+    assert "hbm-peak-over-capacity" in text
+    assert "hbm-undonated-input" in text
+    rules = {json.loads(line)["rule"]
+             for line in out_jsonl.read_text().splitlines()}
+    assert "hbm-peak-over-capacity" in rules
+
+
+def test_cli_sarif(cli, capsys):
+    rc = cli.run(["--fixture", "undonated-longctx", "--format", "sarif"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "paddle-tpu-mem-lint"
+    assert doc["runs"][0]["results"]
+
+
+def test_bench_sentinel_tracks_hbm_peak():
+    """Satellite: BENCH/SERVE history rounds carrying hbm_peak_bytes are
+    tracked as lower-better metrics."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "bench_sentinel.py")
+    spec = importlib.util.spec_from_file_location("bench_sentinel_cli", path)
+    sentinel = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sentinel)
+    bench = sentinel.extract_bench(
+        {"parsed": {"value": 10.0}, "telemetry": {"hbm_peak_bytes": 4096}})
+    assert bench["hbm_peak_bytes"] == (4096.0, "lower")
+    serve = sentinel.extract_serve(
+        {"value": 5.0, "telemetry": {"hbm_peak_bytes": 2048}})
+    assert serve["hbm_peak_bytes"] == (2048.0, "lower")

@@ -71,7 +71,12 @@ def extract_bench(doc):
     for path, name, direction in (
             (("telemetry", "comm_fraction"), "comm_fraction", "lower"),
             (("parsed", "comm_fraction"), "comm_fraction", "lower"),
-            (("telemetry", "recompile_count"), "recompile_count", "lower")):
+            (("telemetry", "recompile_count"), "recompile_count", "lower"),
+            # devprof's hbm.peak_bytes gauge, when the round carried it:
+            # a step whose compiled peak creeps up is a regression even
+            # while throughput holds (it forecloses batch-size headroom)
+            (("telemetry", "hbm_peak_bytes"), "hbm_peak_bytes", "lower"),
+            (("parsed", "hbm_peak_bytes"), "hbm_peak_bytes", "lower")):
         v = _get(doc, *path)
         if isinstance(v, (int, float)) and name not in out:
             out[name] = (float(v), direction)
@@ -93,6 +98,7 @@ def extract_serve(doc):
              "decode_compiles", "equal"),
             (("decode_lint", "shape_churn_findings"),
              "shape_churn_findings", "lower"),
+            (("telemetry", "hbm_peak_bytes"), "hbm_peak_bytes", "lower"),
             # chaos_serve verdict (1.0 = every resilience contract held);
             # 'equal' direction: ANY flip from the baseline is a regression
             (("chaos_ok",), "chaos_ok", "equal")):

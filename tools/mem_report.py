@@ -122,6 +122,9 @@ def build_report(hbm, cost, pipeline, comm_gauges, comm_bytes, comm_count,
         if alias:
             lines.append(f"  {'alias (donated, reused)':<24} "
                          f"{'-' + human_bytes(alias):>14}")
+        if hbm.get("alias_unavailable"):
+            lines.append("  alias term unavailable (persistent-cache "
+                         "executable): peak over-counts donated arguments")
     if cost:
         lines.append("compiled cost:")
         if cost.get("flops"):

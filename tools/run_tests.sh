@@ -138,6 +138,12 @@ PYEOF
       --fixture mismatched-constraint > /dev/null 2>&1; then
     echo "shard_lint missed the mismatched-constraint fixture" >&2; exit 1
   fi
+  # mem-lint gate (ISSUE 12): per-eqn liveness over the zoo — the clean
+  # configs must lint with zero errors AND the predicted HBM peak must
+  # agree with compiled.memory_analysis() within rtol (--measure, never
+  # under-predicting), while the undonated long-context fixture MUST be
+  # flagged over its injected budget (exit 1); --smoke runs both legs
+  JAX_PLATFORMS=cpu python tools/mem_lint.py --smoke
   # serving smoke (tiny gpt, CPU): continuous batching vs sequential
   # decode through the static KV cache; bench_serve --smoke hard-asserts
   # the telemetry contract — serve.tokens_per_s / serve.p95_latency_s
