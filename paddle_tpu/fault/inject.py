@@ -33,6 +33,14 @@ point                   instrumented site
 ``serve.decode``        ``serving.GenerationEngine.decode_once`` — same
                         cache-safe placement; ``oom`` drives the degraded
                         decode path (evict largest victim, retry tick)
+``serve.draft``         ``serving.Scheduler._spec_tick`` draft proposal —
+                        ``error`` drops every proposal for the tick, which
+                        must decode plain (speculation is an accelerator,
+                        never a liveness dependency)
+``serve.verify``        ``serving.GenerationEngine.verify_once`` — fires
+                        BEFORE the compiled verify step (cache intact);
+                        ``error``/``oom`` force the tick to fall back to
+                        plain decode (``serve.spec_fallback_ticks``)
 ``serve.evict``         ``serving.Scheduler._evict`` — an injected
                         ``error`` must NOT lose the request (eviction
                         completes; counted as ``serve.evict_faults``)
@@ -73,7 +81,8 @@ ENV_VAR = "PADDLE_TPU_FAULT_INJECT"
 STALL_ENV_VAR = "PADDLE_TPU_FAULT_STALL_S"
 KINDS = ("sigterm", "kill", "error", "torn", "oom", "stall")
 POINTS = ("ckpt.write", "train.step", "stage", "worker.fetch", "dispatch",
-          "serve.admit", "serve.prefill", "serve.decode", "serve.evict")
+          "serve.admit", "serve.prefill", "serve.decode", "serve.evict",
+          "serve.draft", "serve.verify")
 
 
 class InjectedResourceExhausted(RuntimeError):

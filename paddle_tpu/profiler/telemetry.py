@@ -153,6 +153,10 @@ class Telemetry(Monitor):
         self._next_step = 0
         self._compiles = {}
         self._warned = set()
+        # step-name -> declared executable-variant count: bucketed programs
+        # (one prefill executable per length bucket) compile N times BY
+        # DESIGN — declaring N keeps recompile_count a churn-only signal
+        self._declared = {}
 
     # -- scalar registry ----------------------------------------------------
     def inc(self, name, n=1):
@@ -250,7 +254,8 @@ class Telemetry(Monitor):
             self._counters["compile.count"] = \
                 self._counters.get("compile.count", 0) + 1
             n = self._compiles[key] = self._compiles.get(key, 0) + 1
-            threshold = self.recompile_warn_threshold
+            threshold = max(self.recompile_warn_threshold,
+                            self._declared.get(key, 1))
             warn = n > threshold and key not in self._warned
             if warn:
                 self._warned.add(key)
@@ -266,11 +271,28 @@ class Telemetry(Monitor):
         with self._lock:
             return dict(self._compiles)
 
+    def declare_variants(self, key, n):
+        """Declare that step ``key`` legitimately compiles up to ``n``
+        executables (one per length bucket / chunk width — the serving
+        tier's compile-once-per-bucket design). ``recompile_count`` then
+        counts only compiles BEYOND the declaration, so the sentinel can
+        gate it at zero as a contract metric instead of absorbing the
+        by-design bucket compiles as churn. Idempotent; the widest
+        declaration wins."""
+        with self._lock:
+            self._declared[key] = max(self._declared.get(key, 1), int(n))
+
+    def declared_variants(self):
+        with self._lock:
+            return dict(self._declared)
+
     @property
     def recompile_count(self):
-        """Compilations beyond the first per step-name (the churn number)."""
+        """Compilations beyond the declared variant count per step-name
+        (the churn number; declarations default to 1)."""
         with self._lock:
-            return sum(n - 1 for n in self._compiles.values() if n > 1)
+            return sum(max(0, n - self._declared.get(k, 1))
+                       for k, n in self._compiles.items())
 
     # -- export -------------------------------------------------------------
     @staticmethod
@@ -369,7 +391,8 @@ class Telemetry(Monitor):
                 "step_phase_s": per_phase,
                 "compiles": dict(self._compiles),
                 "recompile_count": sum(
-                    n - 1 for n in self._compiles.values() if n > 1),
+                    max(0, n - self._declared.get(k, 1))
+                    for k, n in self._compiles.items()),
             }
 
     def export_scalars(self, writer, step=None):

@@ -13,11 +13,13 @@ deterministic chaos harness.
 """
 from .kv_cache import (  # noqa: F401
     KVCache,
+    ChunkView,
     DecodeView,
     PrefillView,
     default_buckets,
     pick_bucket,
 )
+from .draft import DraftProposer, NgramProposer  # noqa: F401
 from .engine import GenerationEngine, EncoderScorer  # noqa: F401
 from .scheduler import (  # noqa: F401
     FINISH_REASONS,
@@ -29,8 +31,11 @@ from .scheduler import (  # noqa: F401
 
 __all__ = [
     "KVCache",
+    "ChunkView",
     "DecodeView",
     "PrefillView",
+    "DraftProposer",
+    "NgramProposer",
     "default_buckets",
     "pick_bucket",
     "GenerationEngine",

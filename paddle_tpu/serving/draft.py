@@ -1,0 +1,80 @@
+"""Draft proposers for speculative decoding (ISSUE 13).
+
+Speculative decoding splits a decode tick into DRAFT (cheap, host-side or
+small-model) and VERIFY (one batched ``[max_batch, k+1]`` forward through
+the target model — see ``engine.serve_verify``). The proposer only ever
+affects SPEED, never output: every draft token is checked against the
+verifier's own greedy argmax and rejected tokens are replaced by it, so
+the committed stream is byte-identical to plain greedy decode (the
+chaos-harness parity gate).
+
+:class:`DraftProposer` is the plug-in interface; :class:`NgramProposer`
+is the shipped zero-model implementation (prompt-lookup decoding: match
+the trailing n-gram of ``prompt + generated`` against its own earlier
+occurrences and propose the continuation). A small-model draft drops in
+by implementing ``propose`` with its own decode loop.
+"""
+from __future__ import annotations
+
+__all__ = ["DraftProposer", "NgramProposer"]
+
+
+class DraftProposer:
+    """Interface: propose up to ``k`` draft tokens to speculate past the
+    request's last committed token.
+
+    ``propose`` MUST be cheap relative to a decode tick and MUST be pure
+    with respect to the request stream (same context -> same drafts) so
+    serving stays deterministic and replayable. Returning fewer than ``k``
+    tokens (or none) is always valid — the verifier pads the window and
+    simply accepts zero drafts.
+    """
+
+    def propose(self, context, k):
+        """``context`` is the request's full token history (prompt +
+        generated, the last entry being the token about to be fed);
+        returns a list of at most ``k`` proposed next tokens."""
+        raise NotImplementedError
+
+    def observe(self, context, accepted):
+        """Optional feedback hook: called after verification with the
+        number of drafts accepted — adaptive proposers can tune
+        aggressiveness; the default is stateless."""
+
+
+class NgramProposer(DraftProposer):
+    """Prompt-lookup decoding: no second model on the host.
+
+    Finds the most recent EARLIER occurrence of the context's trailing
+    n-gram (longest match first, ``max_ngram`` down to ``min_ngram``) and
+    proposes the tokens that followed it. Degenerate greedy loops and
+    copy-heavy outputs (summaries, code edits) hit this constantly;
+    novel text simply yields no match and costs one list scan.
+    """
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need max_ngram >= min_ngram >= 1, got "
+                f"({max_ngram}, {min_ngram})")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, context, k):
+        n_ctx = len(context)
+        if k <= 0 or n_ctx < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_ctx - 1), self.min_ngram - 1,
+                       -1):
+            suffix = list(context[-n:])
+            # scan right-to-left for the most recent EARLIER occurrence
+            for i in range(n_ctx - n - 1, -1, -1):
+                if list(context[i:i + n]) == suffix:
+                    start = i + n
+                    # the verify window is a STATIC [batch, k+1] shape, so
+                    # short proposals save nothing — extrapolate the match
+                    # cyclically to the full k (a greedy loop of period d
+                    # predicts perfectly; elsewhere the tail just rejects)
+                    d = (n_ctx - n) - i
+                    return [context[start + (j % d)] for j in range(k)]
+        return []

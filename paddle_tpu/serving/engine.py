@@ -1,18 +1,41 @@
 """Prefill/decode generation engine over the static-shape KV cache.
 
-Two :class:`~paddle_tpu.jit.functionalize.CompiledStep` programs:
+Up to four :class:`~paddle_tpu.jit.functionalize.CompiledStep` programs:
 
 * ``serve_prefill`` — one request's prompt, padded to a length bucket,
   runs causally and writes its K/V into the request's batch slot. One
   executable per bucket (telemetry ``compile[serve_prefill]`` == buckets
   touched), because the bucket width is the ONLY shape that varies — the
   prompt length, slot index and position are traced scalars.
+* ``serve_prefill_chunk`` (when ``prefill_chunk`` is set) — ONE fixed-size
+  chunk of one prompt, written at a traced ``(slot, offset)``. A long
+  prompt becomes ``ceil(n / chunk)`` dispatches the scheduler interleaves
+  with decode ticks, so admitting a long prompt no longer stalls active
+  streams for its full prefill. Compiles exactly once: chunk width is the
+  only shape and it is fixed.
 * ``serve_decode`` — ONE token per batch slot, every slot at its own
   position. All shapes are fixed at ``[max_batch, 1]`` + the cache
   buffers, so this compiles exactly once and its per-step cost is O(1)
   in generated length.
+* ``serve_verify`` (when ``spec_k > 0``) — the speculative-decoding
+  verifier: ``[max_batch, spec_k + 1]`` tokens (each slot's last
+  committed token + k draft tokens) in ONE forward. Because batched
+  decode on this class of model is weight-bandwidth-bound, verifying
+  k+1 positions costs roughly one decode tick; every accepted draft is
+  a decode tick saved. The step returns the verifier's own greedy
+  argmax at every window position — acceptance and commitment happen
+  host-side (:meth:`GenerationEngine.verify_once` +
+  :meth:`GenerationEngine.commit_lengths`), which is what makes the
+  committed stream byte-identical to plain greedy decode.
 
-Both steps thread the model through ``stateful=[model]`` (weights donated
+Sampling (temperature / top-k / top-p) rides the decode and verify steps
+as per-slot TRACED arrays (``keys/temps/top_ks/top_ps``): changing a
+request's sampling params changes data, never shapes, so the
+``retrace-*`` lint rules stay clean and the compile counters stay
+bounded. Greedy remains the default (all temps 0) and the whole sampled
+branch sits behind one ``lax.cond`` so pure-greedy batches skip it.
+
+All steps thread the model through ``stateful=[model]`` (weights donated
 state, aliased in place) and the cache through ``donate_inputs`` so the
 ``dynamic_update_slice`` writes recycle the cache HBM instead of copying
 it — reusing the donation machinery the training pipeline built
@@ -31,9 +54,11 @@ import jax.numpy as jnp
 from ..fault import inject as _inject
 from ..framework.tensor import Tensor
 from ..jit.functionalize import CompiledStep
+from ..profiler import telemetry as _telemetry
 from ..profiler import tracing as _tracing
 from .kv_cache import (
     MASK_MIN,
+    ChunkView,
     DecodeView,
     KVCache,
     PrefillView,
@@ -43,6 +68,55 @@ from .kv_cache import (
 )
 
 __all__ = ["GenerationEngine", "EncoderScorer"]
+
+
+def _sample_next(logits, keys, temps, top_ks, top_ps):
+    """Per-slot next-token selection over ``[batch, vocab]`` logits.
+
+    Greedy slots (``temps[i] == 0``) take the argmax; sampled slots draw
+    from the temperature-scaled distribution after top-k/top-p
+    filtering, each slot under its OWN threefry key (streams are
+    independent per slot and deterministic per seed). The sampled branch
+    sits behind ``lax.cond`` so an all-greedy batch pays only the argmax
+    — and because the branch predicate is DATA, flipping a request to
+    sampling never recompiles.
+
+    Keys advance by one split per call for every slot, sampled or not,
+    so a slot's stream depends only on (seed, ticks since seeding) —
+    the determinism the seeded-sampling tests pin down.
+
+    Returns ``(next_tok int32 [batch], new_keys uint32 [batch, 2])``.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    new_keys = jax.vmap(lambda k: jax.random.split(k, 1)[0])(keys)
+
+    def _sampled(ops):
+        lg, ks, t, tk, tp = ops
+        vocab = lg.shape[-1]
+        scaled = lg.astype(jnp.float32) / jnp.maximum(t, 1e-6)[:, None]
+        # top-k: keep logits >= the k-th largest (sorted-descending
+        # threshold at index k-1); top_k == 0 disables
+        desc = -jnp.sort(-scaled, axis=-1)
+        k_idx = jnp.clip(tk - 1, 0, vocab - 1)
+        k_thresh = jnp.take_along_axis(desc, k_idx[:, None], axis=-1)
+        keep = jnp.where((tk > 0)[:, None], scaled >= k_thresh, True)
+        # top-p: smallest prefix of the sorted distribution with
+        # cumulative probability >= top_p (exclusive-cumsum < top_p keeps
+        # at least the head token); top_p == 1 disables
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cnt = jnp.maximum(
+            ((cum - probs) < tp[:, None]).astype(jnp.int32).sum(-1), 1)
+        p_thresh = jnp.take_along_axis(desc, (cnt - 1)[:, None], axis=-1)
+        keep = keep & jnp.where((tp < 1.0)[:, None],
+                                scaled >= p_thresh, True)
+        filt = jnp.where(keep, scaled, MASK_MIN)
+        return jax.vmap(jax.random.categorical)(ks, filt).astype(jnp.int32)
+
+    sampled = jax.lax.cond(
+        jnp.any(temps > 0.0), _sampled, lambda ops: greedy,
+        (logits, keys, temps, top_ks, top_ps))
+    return jnp.where(temps > 0.0, sampled, greedy), new_keys
 
 
 class GenerationEngine:
@@ -72,11 +146,20 @@ class GenerationEngine:
             so weights stay threaded state there. A frozen engine
             snapshots the weights at compile — rebuild it after updating
             the model.
+        spec_k: speculative-decoding draft window — build the
+            ``serve_verify`` step over ``[max_batch, spec_k + 1]``
+            windows. 0 (default) builds no verifier; the scheduler
+            falls back to plain one-token decode.
+        prefill_chunk: chunked-prefill width — build the
+            ``serve_prefill_chunk`` step. None (default) keeps prefill
+            one-shot-per-bucket only. Prompts whose padded chunk count
+            would overrun ``max_len`` (see :meth:`chunked_prefill_fits`)
+            fall back to the bucketed one-shot path.
     """
 
     def __init__(self, model, *, max_batch=8, max_len=None,
                  prefill_buckets=None, cache_dtype=None,
-                 freeze_weights="auto"):
+                 freeze_weights="auto", spec_k=0, prefill_chunk=None):
         cfg = model.cfg
         model.eval()
         self.model = model
@@ -93,6 +176,20 @@ class GenerationEngine:
             raise ValueError(
                 f"prefill bucket {self.prefill_buckets[-1]} exceeds "
                 f"max_len={self.max_len}")
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k and self.spec_k + 1 > self.max_len:
+            raise ValueError(
+                f"spec_k={self.spec_k} needs a [*, {self.spec_k + 1}] "
+                f"verify window but max_len is {self.max_len}")
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk is not None and not (
+                1 <= self.prefill_chunk <= self.max_len):
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} outside "
+                f"[1, max_len={self.max_len}]")
         self.num_layers = cfg.num_layers
         self.num_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
@@ -107,6 +204,13 @@ class GenerationEngine:
             freeze_weights = jax.default_backend() == "cpu"
         self.freeze_weights = bool(freeze_weights)
         self._footprints = None  # predicted_footprints() cache
+        # per-slot sampling state: DATA threaded through the compiled
+        # steps (shapes fixed at [max_batch]), never compile-time consts
+        self._keys = jnp.stack(
+            [jax.random.PRNGKey(i) for i in range(self.max_batch)])
+        self._temps = np.zeros((self.max_batch,), np.float32)
+        self._top_ks = np.zeros((self.max_batch,), np.int32)
+        self._top_ps = np.ones((self.max_batch,), np.float32)
         stateful = [] if self.freeze_weights else [model]
         self._prefill_step = CompiledStep(
             self._make_prefill(), stateful=stateful, donate_state=True,
@@ -114,6 +218,16 @@ class GenerationEngine:
         self._decode_step = CompiledStep(
             self._make_decode(), stateful=stateful, donate_state=True,
             donate_inputs=["args[1]"])
+        self._verify_step = None
+        if self.spec_k:
+            self._verify_step = CompiledStep(
+                self._make_verify(), stateful=stateful, donate_state=True,
+                donate_inputs=["args[1]"])
+        self._chunk_step = None
+        if self.prefill_chunk:
+            self._chunk_step = CompiledStep(
+                self._make_chunk_prefill(), stateful=stateful,
+                donate_state=True, donate_inputs=["args[4]"])
 
     # -- traced step bodies --------------------------------------------------
     def _make_prefill(self):
@@ -152,17 +266,58 @@ class GenerationEngine:
 
         return serve_prefill
 
+    def _make_chunk_prefill(self):
+        model = self.model
+        max_len = self.max_len
+
+        def serve_prefill_chunk(tokens, chunk_len, off, slot, cache):
+            # tokens [1, chunk] int32; chunk_len/off/slot traced 0-d int32.
+            # Chunk queries sit at absolute positions off..off+chunk-1 and
+            # attend over the slot's FULL row (earlier chunks included):
+            # ChunkView returns the row, the mask admits keys j <= off + i.
+            cl = _leaf(chunk_len).astype(jnp.int32)
+            of = _leaf(off).astype(jnp.int32)
+            sl = _leaf(slot).astype(jnp.int32)
+            chunk = tokens.shape[1]
+            i = jnp.arange(chunk, dtype=jnp.int32)
+            pos = of + i
+            key_idx = jnp.arange(max_len, dtype=jnp.int32)
+            valid = key_idx[None, :] <= pos[:, None]  # [chunk, max_len]
+            mask = jnp.where(valid, 0.0, MASK_MIN)[None, None]
+            mask = mask.astype(jnp.float32)
+            views = [ChunkView(cache.ks[l], cache.vs[l], sl, of)
+                     for l in range(len(cache.ks))]
+            logits, views = model(
+                tokens, position_ids=Tensor(pos[None, :]),
+                attn_mask=Tensor(mask), cache=views)
+            lv = _leaf(logits)  # [1, chunk, vocab]
+            # only meaningful on the FINAL chunk (the host reads it then);
+            # padded tail queries beyond chunk_len produce garbage logits
+            # never read — same contract as serve_prefill
+            last = jax.lax.dynamic_slice(
+                lv, (jnp.int32(0), cl - 1, jnp.int32(0)),
+                (1, 1, lv.shape[-1]))[0, 0]
+            next_tok = jnp.argmax(last).astype(jnp.int32)
+            new_len = jax.lax.dynamic_update_slice(
+                _leaf(cache.lengths),
+                jnp.minimum(of + cl, max_len)[None], (sl,))
+            new_cache = KVCache(tuple(v.k for v in views),
+                                tuple(v.v for v in views), new_len)
+            return Tensor(next_tok), new_cache
+
+        return serve_prefill_chunk
+
     def _make_decode(self):
         model = self.model
         max_len = self.max_len
 
-        def serve_decode(tokens, cache):
+        def serve_decode(tokens, cache, keys, temps, top_ks, top_ps):
             # tokens [max_batch, 1] int32 — each slot's last token, fed at
             # that slot's own position; shapes NEVER vary step to step
             ln = _leaf(cache.lengths).astype(jnp.int32)
             pos = jnp.minimum(ln, max_len - 1)  # [b]
-            keys = jnp.arange(max_len, dtype=jnp.int32)
-            valid = keys[None, :] <= pos[:, None]  # [b, max_len]
+            kidx = jnp.arange(max_len, dtype=jnp.int32)
+            valid = kidx[None, :] <= pos[:, None]  # [b, max_len]
             mask = jnp.where(valid, 0.0, MASK_MIN).astype(jnp.float32)
             mask = mask[:, None, None, :]  # [b, 1, 1, max_len]
             views = [DecodeView(cache.ks[l], cache.vs[l], pos)
@@ -171,17 +326,113 @@ class GenerationEngine:
                 tokens, position_ids=Tensor(pos[:, None]),
                 attn_mask=Tensor(mask), cache=views)
             last = _leaf(logits)[:, -1]  # [b, vocab]
-            # greedy argmax ON DEVICE: only [b] int32 crosses back to the
-            # host per step, never the [b, vocab] logits
-            next_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            # token selection ON DEVICE: only [b] int32 (+ the rotated
+            # keys) crosses back to the host, never the [b, vocab] logits
+            next_tok, new_keys = _sample_next(
+                last, _leaf(keys), _leaf(temps),
+                _leaf(top_ks), _leaf(top_ps))
             new_cache = KVCache(tuple(v.k for v in views),
                                 tuple(v.v for v in views),
                                 Tensor(ln + 1))
-            return Tensor(next_tok), new_cache
+            return Tensor(next_tok), Tensor(new_keys), new_cache
 
         return serve_decode
 
+    def _make_verify(self):
+        model = self.model
+        max_len = self.max_len
+        W = self.spec_k + 1
+
+        def serve_verify(tokens, cache, keys, temps, top_ks, top_ps):
+            # tokens [max_batch, W] int32 — window = [last committed
+            # token, k drafts]; each slot's window sits at its OWN
+            # positions ln..ln+W-1. K/V for all W positions are written
+            # by this step (DecodeView multi-row write), so the accepted
+            # prefix is already cached when the host commits lengths;
+            # rejected positions sit beyond the committed length =
+            # garbage-by-contract, masked until overwritten.
+            ln = _leaf(cache.lengths).astype(jnp.int32)
+            # the scheduler guarantees ln + W <= max_len for LIVE slots
+            # (headroom fallback to plain decode otherwise); the clamp
+            # only ever moves dead slots, whose rows nobody reads
+            pos0 = jnp.minimum(ln, max_len - W)  # [b]
+            offs = jnp.arange(W, dtype=jnp.int32)
+            pos = pos0[:, None] + offs[None, :]  # [b, W]
+            kidx = jnp.arange(max_len, dtype=jnp.int32)
+            valid = kidx[None, None, :] <= pos[:, :, None]  # [b, W, max_len]
+            mask = jnp.where(valid, 0.0, MASK_MIN).astype(jnp.float32)
+            mask = mask[:, None]  # [b, 1, W, max_len]
+            views = [DecodeView(cache.ks[l], cache.vs[l], pos0)
+                     for l in range(len(cache.ks))]
+            logits, views = model(
+                tokens, position_ids=Tensor(pos),
+                attn_mask=Tensor(mask), cache=views)
+            lv = _leaf(logits).astype(jnp.float32)  # [b, W, vocab]
+            # greedy[b, i] = the verifier's own next token GIVEN the
+            # window prefix up to i — the host accepts the longest draft
+            # prefix matching it, then emits greedy[b, a] itself, which
+            # is exactly what plain greedy decode would have produced
+            greedy = jnp.argmax(lv, axis=-1).astype(jnp.int32)
+            # sampled slots never speculate: their committed token is the
+            # window-position-0 draw (same logits a plain tick sees)
+            tok0, new_keys = _sample_next(
+                lv[:, 0], _leaf(keys), _leaf(temps),
+                _leaf(top_ks), _leaf(top_ps))
+            # lengths UNCHANGED — the host commits the accepted count
+            # (commit_lengths) after comparing drafts to greedy
+            new_cache = KVCache(tuple(v.k for v in views),
+                                tuple(v.v for v in views), Tensor(ln))
+            return (Tensor(greedy), Tensor(tok0), Tensor(new_keys),
+                    new_cache)
+
+        return serve_verify
+
     # -- host-side API -------------------------------------------------------
+    def _declare_variants(self):
+        """(Re-)declare each serving step's legitimate executable count
+        with telemetry so ``recompile_count`` stays a clean contract
+        metric (0 = nothing retraced beyond the declared bucketing).
+        Re-declared on every dispatch because ``telemetry.reset()`` swaps
+        the Telemetry instance — the cost is a dict max under a lock."""
+        if not _telemetry.enabled():
+            return
+        tm = _telemetry.get_telemetry()
+        tm.declare_variants("serve_prefill", len(self.prefill_buckets))
+        tm.declare_variants("serve_decode", 1)
+        if self._verify_step is not None:
+            tm.declare_variants("serve_verify", 1)
+        if self._chunk_step is not None:
+            tm.declare_variants("serve_prefill_chunk", 1)
+
+    def set_slot_sampling(self, slot, *, temperature=0.0, top_k=0,
+                          top_p=1.0, seed=0):
+        """Arm sampling for a batch slot: temperature scaling with
+        optional top-k / top-p (nucleus) filtering, seeded per request.
+        All four are DATA in fixed ``[max_batch]`` arrays threaded
+        through the compiled steps — arming/clearing a slot never
+        recompiles. ``temperature=0`` keeps the slot greedy."""
+        s = int(slot)
+        if not (0 <= s < self.max_batch):
+            raise ValueError(f"slot {slot} outside [0, {self.max_batch})")
+        if temperature < 0 or not (0.0 < top_p <= 1.0) or top_k < 0:
+            raise ValueError(
+                f"bad sampling params: temperature={temperature} "
+                f"top_k={top_k} top_p={top_p}")
+        self._temps[s] = float(temperature)
+        self._top_ks[s] = int(top_k)
+        self._top_ps[s] = float(top_p)
+        self._keys = self._keys.at[s].set(jax.random.PRNGKey(int(seed)))
+
+    def clear_slot_sampling(self, slot):
+        """Return a slot to greedy decoding (the default)."""
+        s = int(slot)
+        self._temps[s] = 0.0
+        self._top_ks[s] = 0
+        self._top_ps[s] = 1.0
+
+    def slot_is_sampled(self, slot):
+        return bool(self._temps[int(slot)] > 0.0)
+
     def prefill(self, slot, prompt_ids):
         """Prefill ``prompt_ids`` into batch slot ``slot``; returns the
         greedy next token (host int). Host↔device: one tiny token readback
@@ -198,6 +449,7 @@ class GenerationEngine:
         bucket = pick_bucket(prompt.size, self.prefill_buckets)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :prompt.size] = prompt
+        self._declare_variants()
         # span nests under the caller's context (a scheduler's per-request
         # prefill span, or roots its own trace standalone); the compiled
         # step's compile event lands inside it on a cold bucket
@@ -213,15 +465,120 @@ class GenerationEngine:
         self.cache = cache  # donated: the old buffers are consumed
         return int(np.asarray(_leaf(tok)))
 
+    def chunked_prefill_fits(self, prompt_len):
+        """True when a prompt of this length can prefill through the
+        chunked step: every chunk write (final one included, PADDED to
+        the chunk width) must land inside ``max_len`` — XLA clamps an
+        overhanging ``dynamic_update_slice``, which would silently stomp
+        valid rows. Callers fall back to the bucketed one-shot prefill
+        when this is False."""
+        if self.prefill_chunk is None:
+            return False
+        c = self.prefill_chunk
+        n = int(prompt_len)
+        return n > 0 and c * ((n + c - 1) // c) <= self.max_len
+
+    def prefill_chunk_step(self, slot, prompt_ids, off):
+        """Run ONE prefill chunk: prompt tokens ``off .. off+chunk`` into
+        slot ``slot``. Returns the greedy next token (host int) when this
+        chunk completed the prompt, else None — callers re-enter with
+        ``off + prefill_chunk`` next tick. The cache length advances to
+        the chunk end as a side effect, so decode/verify garbage writes
+        at the partial slot stay above the valid region and are
+        overwritten by the next chunk."""
+        if self._chunk_step is None:
+            raise RuntimeError(
+                "engine was built without prefill_chunk; pass "
+                "prefill_chunk= to GenerationEngine to enable chunked "
+                "prefill")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        c = self.prefill_chunk
+        off = int(off)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens leaves no room to "
+                f"generate within max_len={self.max_len}")
+        if not (0 <= int(slot) < self.max_batch):
+            raise ValueError(f"slot {slot} outside [0, {self.max_batch})")
+        if off % c or not (0 <= off < prompt.size):
+            raise ValueError(
+                f"chunk offset {off} not a multiple of {c} inside the "
+                f"{prompt.size}-token prompt")
+        if off + c > self.max_len:
+            raise ValueError(
+                f"chunk [{off}, {off + c}) overruns max_len="
+                f"{self.max_len}; gate on chunked_prefill_fits()")
+        piece = prompt[off:off + c]
+        toks = np.zeros((1, c), np.int32)
+        toks[0, :piece.size] = piece
+        self._declare_variants()
+        _inject.check("serve.prefill")  # pre-donation: retry-safe
+        with _tracing.span("serve_prefill_chunk",
+                           attrs={"slot": int(slot), "off": off,
+                                  "chunk_tokens": int(piece.size),
+                                  "prompt_tokens": int(prompt.size)}):
+            tok, cache = self._chunk_step(
+                toks, np.int32(piece.size), np.int32(off), np.int32(slot),
+                self.cache)
+        self.cache = cache
+        if off + piece.size >= prompt.size:
+            return int(np.asarray(_leaf(tok)))
+        return None
+
     def decode_once(self, last_tokens):
         """One batched decode step: ``last_tokens[b]`` is each slot's most
         recent token. Returns the next token per slot (np int32 [b])."""
         feed = np.asarray(last_tokens, np.int32).reshape(self.max_batch, 1)
+        self._declare_variants()
         _inject.check("serve.decode")  # pre-donation: cache-safe on retry
         with _tracing.span("serve_decode"):
-            tok, cache = self._decode_step(feed, self.cache)
+            tok, keys, cache = self._decode_step(
+                feed, self.cache, self._keys, self._temps,
+                self._top_ks, self._top_ps)
         self.cache = cache
+        self._keys = _leaf(keys)
         return np.asarray(_leaf(tok))
+
+    def verify_once(self, window_tokens):
+        """One speculative verify step over ``[max_batch, spec_k + 1]``
+        windows (``window[b, 0]`` = slot b's last committed token,
+        ``window[b, 1:]`` = draft tokens; pad unused lanes with 0).
+
+        Returns ``(greedy [b, W] int32, tok0 [b] int32)`` numpy:
+        ``greedy[b, i]`` is the verifier's next token given the window
+        prefix through i (the host's acceptance comparison), ``tok0[b]``
+        the sampled/greedy committed token at window position 0 for
+        slots that don't speculate. Cache lengths are NOT advanced —
+        call :meth:`commit_lengths` with the per-slot accepted counts."""
+        if self._verify_step is None:
+            raise RuntimeError(
+                "engine was built with spec_k=0; pass spec_k= to "
+                "GenerationEngine to enable speculative decoding")
+        w = self.spec_k + 1
+        feed = np.asarray(window_tokens, np.int32).reshape(
+            self.max_batch, w)
+        self._declare_variants()
+        _inject.check("serve.verify")  # pre-donation: cache-safe on retry
+        with _tracing.span("serve_verify", attrs={"window": w}):
+            greedy, tok0, keys, cache = self._verify_step(
+                feed, self.cache, self._keys, self._temps,
+                self._top_ks, self._top_ps)
+        self.cache = cache
+        self._keys = _leaf(keys)
+        return (np.asarray(_leaf(greedy)), np.asarray(_leaf(tok0)))
+
+    def commit_lengths(self, advance):
+        """Advance per-slot cached lengths by ``advance[b]`` tokens after
+        host-side speculative acceptance. A tiny [max_batch] device add
+        (no compiled-step dispatch, no readback): the K/V rows being
+        committed were already written by the verify step."""
+        adv = jnp.asarray(np.asarray(advance, np.int32)
+                          .reshape(self.max_batch))
+        ln = _leaf(self.cache.lengths).astype(jnp.int32)
+        self.cache = KVCache(self.cache.ks, self.cache.vs,
+                             jnp.minimum(ln + adv, self.max_len))
 
     def generate(self, prompt_ids, max_new_tokens=32, eos_id=None):
         """Greedy single-request generation (slot 0; other slots idle).
@@ -274,9 +631,8 @@ class GenerationEngine:
         try:
             from .. import analysis
 
-            tokens, cache = self.example_decode_args([1])
             timeline = analysis.analyze_memory(
-                self._decode_step, tokens, cache)
+                self._decode_step, *self.example_decode_args([1]))
             decode_peak = float(timeline.peak_bytes)
         except Exception:  # noqa: BLE001 - advisory: fall back to arithmetic
             decode_peak = float(2 * cache_bytes)
@@ -295,25 +651,56 @@ class GenerationEngine:
     @property
     def decode_step(self):
         """The compiled decode step — exposed for graph-lint
-        (``analysis.lint_step(engine.decode_step, tokens, cache, ...)``)."""
+        (``analysis.lint_step(engine.decode_step, *example_args, ...)``)."""
         return self._decode_step
 
     @property
     def prefill_step(self):
         return self._prefill_step
 
-    def example_decode_args(self, lengths):
-        """A shape-faithful (tokens, cache) example batch for static lint:
-        fresh (non-donated) cache buffers with the given per-slot lengths.
-        Two consecutive positions lint identically — that IS the O(1)
-        contract the ``kv-cache-concat`` rule checks."""
+    @property
+    def verify_step(self):
+        """The compiled speculative verify step (None when spec_k=0)."""
+        return self._verify_step
+
+    @property
+    def chunk_step(self):
+        """The compiled chunked-prefill step (None when disabled)."""
+        return self._chunk_step
+
+    def _example_sampling_args(self):
+        return (np.zeros((self.max_batch, 2), np.uint32),
+                np.zeros((self.max_batch,), np.float32),
+                np.zeros((self.max_batch,), np.int32),
+                np.ones((self.max_batch,), np.float32))
+
+    def _example_cache(self, lengths):
         ln = np.zeros((self.max_batch,), np.int32)
         ln[:len(lengths)] = np.asarray(lengths, np.int32)
         cache = KVCache.alloc(self.num_layers, self.max_batch, self.max_len,
-                              self.num_heads, self.head_dim, self.cache_dtype)
-        cache = KVCache(cache.ks, cache.vs, jnp.asarray(ln))
+                              self.num_heads, self.head_dim,
+                              self.cache_dtype)
+        return KVCache(cache.ks, cache.vs, jnp.asarray(ln))
+
+    def example_decode_args(self, lengths):
+        """A shape-faithful ``(tokens, cache, keys, temps, top_ks,
+        top_ps)`` example batch for static lint: fresh (non-donated)
+        cache buffers with the given per-slot lengths. Two consecutive
+        positions lint identically — that IS the O(1) contract the
+        ``kv-cache-concat`` rule checks."""
         tokens = np.zeros((self.max_batch, 1), np.int32)
-        return tokens, cache
+        return (tokens, self._example_cache(lengths),
+                *self._example_sampling_args())
+
+    def example_verify_args(self, lengths):
+        """Shape-faithful example batch for linting the speculative
+        verify step — same contract as :meth:`example_decode_args` but
+        with a ``[max_batch, spec_k + 1]`` token window."""
+        if self._verify_step is None:
+            raise RuntimeError("engine was built with spec_k=0")
+        tokens = np.zeros((self.max_batch, self.spec_k + 1), np.int32)
+        return (tokens, self._example_cache(lengths),
+                *self._example_sampling_args())
 
 
 class EncoderScorer:
@@ -350,6 +737,9 @@ class EncoderScorer:
         numpy logits. Requests are chunked to ``max_batch`` and padded to
         the smallest bucket that fits the chunk's longest sequence."""
         seqs = [np.asarray(s, np.int32).reshape(-1) for s in sequences]
+        if _telemetry.enabled():
+            _telemetry.get_telemetry().declare_variants(
+                "serve_score", len(self.seq_buckets))
         outs = []
         for lo in range(0, len(seqs), self.max_batch):
             chunk = seqs[lo:lo + self.max_batch]

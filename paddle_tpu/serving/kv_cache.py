@@ -30,8 +30,8 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
 
-__all__ = ["KVCache", "DecodeView", "PrefillView", "pick_bucket",
-           "default_buckets"]
+__all__ = ["KVCache", "DecodeView", "PrefillView", "ChunkView",
+           "pick_bucket", "default_buckets"]
 
 #: additive-mask floor: large enough to zero a softmax lane in fp32/bf16
 #: without producing inf-inf NaNs when a whole row is masked
@@ -208,3 +208,45 @@ class PrefillView:
         self.k = jax.lax.dynamic_update_slice(self.k, kn, start)
         self.v = jax.lax.dynamic_update_slice(self.v, vn, start)
         return k_new, v_new, self
+
+
+class ChunkView:
+    """One layer's cache view for CHUNKED prefill (prompt chunk ``c`` of a
+    long prompt, written at row ``slot`` offset ``off``).
+
+    Unlike :class:`PrefillView` (chunk 0 only: no prior context, so the
+    chunk tensors alone feed attention), a later chunk's queries must
+    attend to everything already prefilled — so ``update`` writes the
+    chunk's K/V at ``(slot, off)`` and returns the slot's FULL buffer row
+    ``[1, max_len, heads, head_dim]`` for attention; the caller's additive
+    mask admits exactly keys ``j <= off + i`` per chunk query ``i``. The
+    shapes entering/leaving the step depend only on the chunk width, so
+    chunked prefill compiles ONCE per chunk width regardless of prompt
+    length or chunk index (``off``/``slot`` are traced scalars).
+
+    Caller contract: ``off + chunk_width <= max_len`` — XLA clamps a
+    ``dynamic_update_slice`` start so an overhanging write would silently
+    shift backwards and stomp valid rows (the engine falls back to the
+    one-shot bucketed prefill when a padded prompt cannot satisfy this).
+    """
+
+    __slots__ = ("k", "v", "slot", "off")
+
+    def __init__(self, k, v, slot, off):
+        self.k = _leaf(k)
+        self.v = _leaf(v)
+        self.slot = _leaf(slot)
+        self.off = _leaf(off)
+
+    def update(self, k_new, v_new):
+        kn = _leaf(k_new).astype(self.k.dtype)  # [1, chunk, heads, head_dim]
+        vn = _leaf(v_new).astype(self.v.dtype)
+        z = jnp.int32(0)
+        sl = self.slot.astype(jnp.int32)
+        start = (sl, self.off.astype(jnp.int32), z, z)
+        self.k = jax.lax.dynamic_update_slice(self.k, kn, start)
+        self.v = jax.lax.dynamic_update_slice(self.v, vn, start)
+        row_shape = (1,) + tuple(self.k.shape[1:])
+        row_k = jax.lax.dynamic_slice(self.k, (sl, z, z, z), row_shape)
+        row_v = jax.lax.dynamic_slice(self.v, (sl, z, z, z), row_shape)
+        return Tensor(row_k), Tensor(row_v), self

@@ -108,6 +108,30 @@ def test_noise_awareness_widens_tolerance():
     assert len(_regressions(f)) == 1
 
 
+def test_step_change_ratchets_baseline():
+    # a 60% jump (beyond tolerance → confirmed step-change, not jitter)
+    # becomes the new bar: sliding back toward the pre-jump level must
+    # flag even though the trailing MEDIAN still sits at the old level
+    f = bench_sentinel.compare(_series([100.0, 100.0, 101.0, 160.0, 120.0]))
+    regs = _regressions(f)
+    assert len(regs) == 1
+    assert regs[0]["baseline"] == pytest.approx(160.0)
+    # holding the new level is clean
+    f = bench_sentinel.compare(_series([100.0, 100.0, 101.0, 160.0, 158.0]))
+    assert _regressions(f) == []
+    # lower-is-better mirrors: latency halves, then creeps back up
+    f = bench_sentinel.compare(_series([10.0, 10.1, 9.9, 5.0, 8.0],
+                                       direction="lower",
+                                       metric="p95_latency_s"))
+    regs = _regressions(f)
+    assert len(regs) == 1
+    assert regs[0]["baseline"] == pytest.approx(5.0)
+    # a within-tolerance wiggle does NOT ratchet (median still rules —
+    # see test_noise_awareness_widens_tolerance for the jitter case)
+    f = bench_sentinel.compare(_series([100.0, 101.0, 99.5, 100.2]))
+    assert f[0]["baseline"] == pytest.approx(100.0)
+
+
 def test_single_round_series_skipped():
     f = bench_sentinel.compare(_series([42.0]))
     assert f[0]["status"] == "no-history"

@@ -232,14 +232,14 @@ def test_rule_liveness_spike():
 
 
 def test_rule_kv_bucket_waste(serve_eng):
-    tokens, cache = serve_eng.example_decode_args([1])
-    rep = analysis.lint_step(serve_eng.decode_step, tokens, cache)
+    args = serve_eng.example_decode_args([1])
+    rep = analysis.lint_step(serve_eng.decode_step, *args)
     hits = rep.by_rule("hbm-kv-bucket-waste")
     assert hits and hits[0].severity == "warning"
     assert "wastes" in hits[0].message
     # near-full occupancy: 60/64 rounds to the top bucket with ~6% waste
-    tokens, cache = serve_eng.example_decode_args([60, 60])
-    clean = analysis.lint_step(serve_eng.decode_step, tokens, cache)
+    args = serve_eng.example_decode_args([60, 60])
+    clean = analysis.lint_step(serve_eng.decode_step, *args)
     assert not clean.by_rule("hbm-kv-bucket-waste")
 
 

@@ -414,9 +414,9 @@ def test_unknown_point_raises_same_error_as_unknown_kind():
     with pytest.raises(ValueError, match="unknown fault point"):
         inject.arm("error", "serve.decoed")  # typo must fail loudly
     for point in ("serve.admit", "serve.prefill", "serve.decode",
-                  "serve.evict"):
+                  "serve.evict", "serve.draft", "serve.verify"):
         assert point in inject.POINTS
-        inject.arm("error", point, at=99)  # all four arm cleanly
+        inject.arm("error", point, at=99)  # all of them arm cleanly
     inject.disarm_all()
 
 
@@ -480,3 +480,134 @@ def test_shed_and_timeout_record_trace_event_spans(eng):
     assert roots[shed.rid].attrs["finish_reason"] == "shed"
     assert roots[kept.rid].attrs["finish_reason"] == "timeout"
     assert all(s.end_ns is not None for s in by_name["request"])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: chunked prefill + speculative decoding under faults
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def spec_eng():
+    """A 2-slot engine with speculation AND chunked prefill armed, every
+    executable warmed — the resilience paths below fault the new surfaces
+    (mid-chunk expiry, between-chunk OOM, draft/verify faults)."""
+    model = _gpt(seed=4)
+    e = GenerationEngine(model, max_batch=2, max_len=64,
+                         prefill_buckets=(8, 16), spec_k=4,
+                         prefill_chunk=4)
+    e.prefill(0, [1] * 7)
+    e.prefill(0, [1] * 12)
+    e.decode_once(np.zeros(2, np.int32))
+    off, tok = 0, None
+    while tok is None:  # two-chunk warm of the chunk step
+        tok = e.prefill_chunk_step(0, [1] * 5, off)
+        off += 4
+    e.verify_once(np.zeros((2, 5), np.int32))  # lengths unchanged
+    return e
+
+
+def test_mid_chunk_deadline_expiry_is_exactly_one_timeout(spec_eng):
+    sched = _sched(spec_eng)
+    req = Request(prompt=list(range(1, 13)), max_new_tokens=4,
+                  deadline_s=60.0)
+    sched.submit(req)
+    sched.step()  # admitted into the chunked path, ONE chunk advanced
+    assert req.slot is not None and not req.finished
+    assert req.prefill_off == 4  # mid-prefill: 1 of 3 chunks done
+    req.deadline_s = 1e-9  # already elapsed: next tick must expire it
+    sched.step()
+    _assert_full_accounting(sched, [req])
+    assert req.finish_reason == "timeout"
+    assert not req.tokens  # died between chunks: no token, no double-count
+    # the freed slot and the engine survive: a fresh request runs clean
+    nxt = Request(prompt=list(range(1, 13)), max_new_tokens=4)
+    sched.submit(nxt)
+    sched.run()
+    assert nxt.finish_reason == "length"
+    assert len(nxt.tokens) == 4
+
+
+def test_oom_between_chunks_evicts_decoder_not_the_prefiller(spec_eng):
+    prompt = list(range(20, 31))  # 11 tokens -> chunks of 4, 4, 3
+    clean = Request(prompt=list(prompt), max_new_tokens=8)
+    solo = _sched(spec_eng)
+    solo.submit(clean)
+    solo.run()
+
+    sched = _sched(spec_eng)
+    hog = Request(prompt=[3, 5, 7], max_new_tokens=12)
+    sched.submit(hog)
+    sched.step()  # hog active and decoding
+    # armed AFTER hog's one-shot prefill, so hit 1 is the newcomer's
+    # first chunk: the OOM lands mid-chunked-prefill, and the victim must
+    # be the DECODING neighbor (the requester is excluded — evicting it
+    # would orphan the retry)
+    inject.arm("oom", "serve.prefill", at=1)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        req = Request(prompt=list(prompt), max_new_tokens=8)
+        sched.submit(req)
+        sched.run()
+        counters = telemetry.get_telemetry().counters()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    _assert_full_accounting(sched, [hog, req])
+    assert hog.finish_reason == "oom_evicted"
+    assert counters["serve.oom_evictions"] == 1
+    # the interrupted-then-retried prefiller still streams EXACTLY what a
+    # clean solo run of the same prompt produced
+    assert req.finish_reason == "length"
+    assert req.tokens == clean.tokens
+
+
+def test_draft_fault_decodes_plain_and_stream_is_byte_identical(spec_eng):
+    # cyclic prompts guarantee the n-gram proposer WOULD draft; the
+    # injected fault drops every proposal for one tick and the scheduler
+    # must decode plain — output identical to the unfaulted run
+    prompts = [[1, 2, 3] * 4, [4, 5] * 5]
+    refs = [Request(prompt=list(p), max_new_tokens=10) for p in prompts]
+    ref_sched = _sched(spec_eng)
+    for r in refs:
+        ref_sched.submit(r)
+    ref_sched.run()
+
+    inject.arm("error", "serve.draft", at=2)
+    sched = _sched(spec_eng)
+    reqs = [Request(prompt=list(p), max_new_tokens=10) for p in prompts]
+    for r in reqs:
+        sched.submit(r)
+    sched.run()
+    _assert_full_accounting(sched, reqs)
+    for r, ref in zip(reqs, refs):
+        assert r.tokens == ref.tokens
+        assert r.finish_reason == "length"
+
+
+def test_verify_fault_falls_back_to_plain_tick_with_counter(spec_eng):
+    prompts = [[6, 7, 8] * 4, [9, 1] * 5]
+    refs = [Request(prompt=list(p), max_new_tokens=10) for p in prompts]
+    ref_sched = _sched(spec_eng, speculative=False)  # plain-greedy truth
+    for r in refs:
+        ref_sched.submit(r)
+    ref_sched.run()
+
+    inject.arm("error", "serve.verify", at=1)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        sched = _sched(spec_eng)
+        reqs = [Request(prompt=list(p), max_new_tokens=10) for p in prompts]
+        for r in reqs:
+            sched.submit(r)
+        sched.run()
+        counters = telemetry.get_telemetry().counters()
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    _assert_full_accounting(sched, reqs)
+    # the faulted tick degraded (counted) and later ticks speculated again
+    assert counters["serve.spec_fallback_ticks"] == 1
+    assert counters.get("serve.spec_ticks", 0) > 0
+    for r, ref in zip(reqs, refs):
+        assert r.tokens == ref.tokens

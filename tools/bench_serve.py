@@ -12,9 +12,20 @@ slot-based continuous batching) and reports:
   sequential baseline pays its queue wait — that is the point);
 * decode-batch occupancy and requests-in-flight from telemetry;
 * the O(1)-decode proof: telemetry compile counters (decode must compile
-  EXACTLY once; prefill once per length bucket) and a static graph-lint
+  EXACTLY once; prefill once per length bucket; the speculative verify
+  and chunked-prefill steps exactly once each) and a static graph-lint
   of the decode step at two consecutive positions (zero shape-churn /
   kv-cache findings).
+
+Serving speed v2 (ISSUE 13): the continuous engine runs with
+speculative decoding (``--spec-k``, n-gram prompt-lookup drafts verified
+in one ``[batch, k+1]`` forward — output stays byte-identical to greedy)
+and chunked prefill (``--prefill-chunk``) ON by default; pass 0 to
+disable either. ``--prompt-len-sweep`` appends TTFT-vs-prompt-length
+rows to the artifact so the flat-TTFT claim is a tracked series, and the
+telemetry block carries ``serve.spec_acceptance_rate`` plus the
+``recompile_whitelist`` marker that lets bench_sentinel hard-gate
+``recompile_count`` as an 'equal' contract metric.
 
 Emits one JSON line and (with ``--artifact``) a SERVE_r*.json. ``--smoke``
 runs a tiny CPU config and hard-asserts the telemetry contract — wired
@@ -96,7 +107,26 @@ def run_sequential(model, requests, max_len, buckets):
     }
 
 
-def run_continuous(model, requests, max_len, buckets, concurrency):
+def warm_engine(eng, buckets, max_len, concurrency):
+    """Compile every serving executable outside the timers: one prefill
+    per bucket, the decode step, and (when built) the chunked-prefill and
+    speculative-verify steps. Compiles still land in telemetry."""
+    for b in buckets:
+        eng.prefill(0, [1] * min(b, max_len - 2))
+    eng.decode_once(np.zeros(concurrency, np.int32))
+    if eng.prefill_chunk:
+        warm = [1] * (eng.prefill_chunk + 1)  # exactly two chunks
+        off, tok = 0, None
+        while tok is None:
+            tok = eng.prefill_chunk_step(0, warm, off)
+            off += eng.prefill_chunk
+    if eng.spec_k:
+        # lengths are NOT advanced by a verify, so this leaves no state
+        eng.verify_once(np.zeros((concurrency, eng.spec_k + 1), np.int32))
+
+
+def run_continuous(model, requests, max_len, buckets, concurrency,
+                   spec_k=0, prefill_chunk=None):
     """Continuous batching under telemetry: compiles (during warmup) and
     the scheduler's serve.* stats all land in the registry."""
     from paddle_tpu.profiler import telemetry
@@ -107,10 +137,9 @@ def run_continuous(model, requests, max_len, buckets, concurrency):
     # lift the per-step-name warning threshold above the bucket count
     telemetry.enable(recompile_warn_threshold=len(buckets) + 2)
     eng = GenerationEngine(model, max_batch=concurrency, max_len=max_len,
-                           prefill_buckets=buckets)
-    for b in buckets:  # warm outside the timer; compiles are still counted
-        eng.prefill(0, [1] * min(b, max_len - 2))
-    eng.decode_once(np.zeros(concurrency, np.int32))
+                           prefill_buckets=buckets, spec_k=spec_k,
+                           prefill_chunk=prefill_chunk or None)
+    warm_engine(eng, buckets, max_len, concurrency)
 
     sched = Scheduler(eng)
     t0 = time.perf_counter()
@@ -168,6 +197,50 @@ def lint_decode(eng):
     }
 
 
+def run_prompt_len_sweep(cfg, model, max_len, buckets, concurrency,
+                         spec_k, prefill_chunk, seed):
+    """TTFT vs prompt length, at queue pressure (2× concurrency, every
+    prompt the same length L): with one-shot prefill the second wave's
+    TTFT inherits every first-wave prefill whole, so p95 TTFT scales
+    with L; chunked prefill amortizes each prompt into bounded per-tick
+    chunks that ride along with decode. Rows land in the artifact so the
+    claim is a tracked series; ``growth_ratio`` < 1 means p95 TTFT grew
+    sub-linearly vs the prompt length itself."""
+    from paddle_tpu.serving import GenerationEngine, Request, Scheduler
+
+    eng = GenerationEngine(model, max_batch=concurrency, max_len=max_len,
+                           prefill_buckets=buckets, spec_k=spec_k,
+                           prefill_chunk=prefill_chunk or None)
+    warm_engine(eng, buckets, max_len, concurrency)
+    max_new = 8  # short decode budget: the sweep isolates TTFT
+    lengths = [x for x in (4, 8, 16, 24, 32)
+               if x <= buckets[-1] and x + max_new <= max_len]
+    rng = np.random.RandomState(seed)
+    rows = []
+    for L in lengths:
+        reqs = [Request(prompt=rng.randint(0, cfg.vocab_size, L).tolist(),
+                        max_new_tokens=max_new)
+                for _ in range(2 * concurrency)]
+        sched = Scheduler(eng)
+        t0 = time.perf_counter_ns()
+        for r in reqs:
+            sched.submit(r)
+            r.submit_ns = t0  # common arrival instant
+        sched.run()
+        ttft = [r.ttft_s for r in reqs if r.ttft_s is not None]
+        rows.append({"prompt_len": int(L),
+                     "requests": len(reqs),
+                     "p50_ttft_s": round(_pctl(ttft, 50), 4),
+                     "p95_ttft_s": round(_pctl(ttft, 95), 4)})
+    lo, hi = rows[0], rows[-1]
+    growth = None
+    if lo["p95_ttft_s"] > 0 and hi["prompt_len"] > lo["prompt_len"]:
+        growth = round((hi["p95_ttft_s"] / lo["p95_ttft_s"])
+                       / (hi["prompt_len"] / lo["prompt_len"]), 4)
+    return {"rows": rows, "growth_ratio": growth,
+            "sub_linear": bool(growth is not None and growth < 1.0)}
+
+
 def telemetry_serve_block():
     from paddle_tpu.profiler import telemetry
 
@@ -178,6 +251,11 @@ def telemetry_serve_block():
     block["compiles"] = dict(s["compiles"])
     block["recompile_count"] = int(s["recompile_count"])
     tm = telemetry.get_telemetry()
+    # the marker bench_sentinel keys on: recompile_count in THIS artifact
+    # is declared-variant aware (per-bucket prefill compiles are design,
+    # not churn), so the sentinel may 'equal'-gate it at 0
+    block["recompile_whitelist"] = {
+        k: int(v) for k, v in sorted(tm.declared_variants().items())}
     for name in ("serve.ttft_s", "serve.tpot_s", "serve.latency_s"):
         st = tm.get(name)
         if st and st.get("count"):
@@ -199,6 +277,14 @@ def main(argv=None):
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--max-new-tokens", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--spec-k", type=int, default=None,
+                    help="speculative draft length (default 4; 0 disables)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked-prefill width (default 16, smoke 4; "
+                         "0 disables)")
+    ap.add_argument("--prompt-len-sweep", action="store_true",
+                    help="append TTFT-vs-prompt-length rows to the "
+                         "artifact (sub-linear growth is the contract)")
     ap.add_argument("--artifact", default=None)
     ap.add_argument("--chaos", action="store_true",
                     help="also run tools/chaos_serve.py and embed its "
@@ -210,6 +296,10 @@ def main(argv=None):
         args.concurrency = min(args.concurrency, 4)
     n_req = args.requests or 2 * args.concurrency
     max_new = args.max_new_tokens or (8 if args.smoke else 64)
+    # serving speed v2 is the default path; 0 opts out of either feature
+    spec_k = 4 if args.spec_k is None else max(0, args.spec_k)
+    prefill_chunk = ((4 if args.smoke else 16) if args.prefill_chunk is None
+                     else max(0, args.prefill_chunk))
 
     cfg, model = build_model(args.smoke)
     # size the cache to the workload: largest prompt (buckets[-1]/2) plus
@@ -228,7 +318,9 @@ def main(argv=None):
 
     sequential = run_sequential(model, seq_requests, max_len, buckets)
     eng, sched, continuous = run_continuous(model, requests, max_len,
-                                            buckets, args.concurrency)
+                                            buckets, args.concurrency,
+                                            spec_k=spec_k,
+                                            prefill_chunk=prefill_chunk)
     lint = lint_decode(eng)
     tblock = telemetry_serve_block()
 
@@ -249,12 +341,20 @@ def main(argv=None):
             "max_len": max_len, "prefill_buckets": list(buckets),
             "concurrency": args.concurrency, "requests": n_req,
             "max_new_tokens": max_new,
+            "spec_k": spec_k, "prefill_chunk": prefill_chunk,
         },
         "sequential": sequential,
         "continuous": continuous,
         "decode_lint": lint,
         "telemetry": tblock,
     }
+    if args.prompt_len_sweep:
+        # runs after the telemetry block is captured so the sweep's own
+        # engine/compiles cannot perturb the contract counters above
+        sweep = run_prompt_len_sweep(cfg, model, max_len, buckets,
+                                     args.concurrency, spec_k,
+                                     prefill_chunk, args.seed)
+        result["prompt_len_sweep"] = sweep
     chaos = None
     if args.chaos:
         # the chaos contract is config-independent, so the harness always
@@ -287,6 +387,23 @@ def main(argv=None):
                         f"(want exactly 1)")
     if tblock["compiles"].get("serve_prefill", 0) > len(buckets):
         problems.append("prefill compiled more than once per bucket")
+    if spec_k and tblock["compiles"].get("serve_verify") != 1:
+        problems.append(f"verify compiled "
+                        f"{tblock['compiles'].get('serve_verify')}x "
+                        f"(want exactly 1)")
+    if prefill_chunk and tblock["compiles"].get("serve_prefill_chunk") != 1:
+        problems.append(f"chunked prefill compiled "
+                        f"{tblock['compiles'].get('serve_prefill_chunk')}x "
+                        f"(want exactly 1)")
+    if tblock["recompile_count"] != 0:
+        problems.append(f"recompile_count {tblock['recompile_count']} "
+                        f"(every variant must be declared)")
+    if spec_k and not tblock.get("serve.spec_ticks"):
+        problems.append("speculation enabled but no speculative ticks ran")
+    sweep = result.get("prompt_len_sweep")
+    if sweep is not None and prefill_chunk and not sweep["sub_linear"]:
+        problems.append(f"p95 TTFT grew super-linearly with prompt length "
+                        f"(growth_ratio {sweep['growth_ratio']})")
     if lint["shape_churn_findings"]:
         problems.append(f"decode lint: {lint['shape_churn_findings']} "
                         f"shape-churn/kv-cache finding(s)")

@@ -9,13 +9,16 @@ through ``paddle_tpu.fault.inject`` and asserts the resilience contract:
   error|drained``), and the ``serve.*`` telemetry counters agree with the
   per-request records;
 * **no scheduler crash** — the injected OOM (``serve.decode``), transient
-  prefill error (``serve.prefill``) and stall are absorbed by the
-  degraded-decode / retry paths;
-* **survivor parity** — every request that still finished normally
-  (``eos``/``length``) produced the SAME token stream as the clean run,
-  token for token (slots are isolated: greedy decode reads only the
-  request's own KV-cache slot, so evictions around it must not perturb
-  it);
+  prefill error (``serve.prefill``), draft fault (``serve.draft``),
+  mid-verify faults (``serve.verify`` error + stall) and stall are
+  absorbed by the degraded-decode / retry / plain-tick-fallback paths;
+* **survivor parity** — the chaos pass serves with speculative decoding
+  and chunked prefill ON while the clean reference runs the PLAIN greedy
+  path (``Scheduler(speculative=False)``); every request that still
+  finished normally (``eos``/``length``) must have produced the SAME
+  token stream, token for token. That is the ISSUE-13 acceptance squared:
+  spec output is byte-identical to greedy even while drafts drop,
+  verifies fault mid-flight and neighbors get evicted around it;
 * **overload pages** — an :class:`~paddle_tpu.profiler.slo.SLOMonitor`
   over the shipped ``SERVING_SLOS`` (driven on a synthetic clock, so burn
   windows are deterministic) must fire on the shed burst;
@@ -54,6 +57,8 @@ CONCURRENCY = 4
 MAX_QUEUE = 4
 BUCKETS = (8, 16)
 MAX_LEN = 64
+SPEC_K = 4
+PREFILL_CHUNK = 4
 
 
 def build_engines(seed=0):
@@ -61,8 +66,9 @@ def build_engines(seed=0):
     the chaos subject and a never-faulted CONTROL. The recovery check
     compares the two in interleaved passes, so slow host drift (thermal,
     another process) cancels instead of masquerading as a regression.
-    Every executable is warmed up front — chaos must measure the steady
-    state, not compiles."""
+    Every executable — per-bucket prefill, decode, chunked prefill,
+    speculative verify — is warmed up front; chaos must measure the
+    steady state, not compiles."""
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving import GenerationEngine
@@ -75,10 +81,18 @@ def build_engines(seed=0):
     engines = []
     for _ in range(2):
         eng = GenerationEngine(model, max_batch=CONCURRENCY,
-                               max_len=MAX_LEN, prefill_buckets=BUCKETS)
+                               max_len=MAX_LEN, prefill_buckets=BUCKETS,
+                               spec_k=SPEC_K, prefill_chunk=PREFILL_CHUNK)
         for b in BUCKETS:
             eng.prefill(0, [1] * (b - 1))
         eng.decode_once(np.zeros(CONCURRENCY, np.int32))
+        off, tok = 0, None
+        warm = [1] * (PREFILL_CHUNK + 1)  # exactly two chunks
+        while tok is None:
+            tok = eng.prefill_chunk_step(0, warm, off)
+            off += PREFILL_CHUNK
+        # a verify does not advance lengths, so warming leaves no state
+        eng.verify_once(np.zeros((CONCURRENCY, SPEC_K + 1), np.int32))
         engines.append(eng)
     return cfg, engines[0], engines[1]
 
@@ -97,10 +111,13 @@ def _new_requests(prompts):
 
 
 def run_clean(eng, prompts):
-    """Reference pass: serve every prompt cleanly, return idx → tokens."""
+    """Reference pass: serve every prompt cleanly through the PLAIN
+    greedy path (speculation forced off), return idx → tokens. The chaos
+    pass then serves with speculation ON, so survivor parity doubles as
+    the spec-vs-greedy byte-identity check under faults."""
     from paddle_tpu.serving import Scheduler
 
-    sched = Scheduler(eng)
+    sched = Scheduler(eng, speculative=False)
     reqs = _new_requests(prompts)
     for r in reqs:
         sched.submit(r)
@@ -177,11 +194,19 @@ def run_chaos(seed=0, reps=3):
                           max_queue=MAX_QUEUE,
                           retry_sleep=lambda s: None)
         # armed faults (fixed hit counts — fully replayable): a transient
-        # prefill error the retry must absorb, an OOM mid-decode that must
-        # evict exactly one victim, and a stall (a slow tick, not a dead one)
+        # prefill error the retry must absorb, a draft fault and two
+        # mid-verify errors that must each fall back to a plain tick,
+        # an OOM on one of those plain ticks (the third serve.decode hit)
+        # that must evict exactly one victim, and a mid-verify stall (a
+        # slow tick, not a dead one). With speculation healthy the
+        # scheduler never decodes plain, so serve.decode hits are created
+        # BY the draft/verify faults — the fallback chain under test.
         inject.arm("error", "serve.prefill", at=2)
+        inject.arm("error", "serve.draft", at=2)
+        inject.arm("error", "serve.verify", at=3)
+        inject.arm("error", "serve.verify", at=5)
         inject.arm("oom", "serve.decode", at=3)
-        inject.arm("stall", "serve.decode", at=6)
+        inject.arm("stall", "serve.verify", at=7)
 
         chaos_reqs = _new_requests(prompts)
         # two requests with an already-expired deadline: deterministic
@@ -239,6 +264,19 @@ def run_chaos(seed=0, reps=3):
         if not counters.get("serve.degraded_steps"):
             problems.append("injected decode OOM did not count a "
                             "degraded step")
+        # the speculative surface must have been exercised AND survived:
+        # spec ticks ran, and both injected verify faults degraded to
+        # plain ticks instead of killing the scheduler
+        if not counters.get("serve.spec_ticks"):
+            problems.append("chaos pass ran no speculative ticks")
+        if int(counters.get("serve.spec_fallback_ticks", 0)) < 2:
+            problems.append(
+                f"expected both injected verify faults to force plain-"
+                f"tick fallbacks, got serve.spec_fallback_ticks="
+                f"{counters.get('serve.spec_fallback_ticks', 0)}")
+        if not counters.get("serve.prefill_chunks"):
+            problems.append("chaos pass never took the chunked-prefill "
+                            "path")
         # abnormal terminations must be queryable as trace event spans
         span_names = {s.name for s in tracing.get_tracer().spans()}
         for want in ("shed", "timeout", "oom_evicted"):

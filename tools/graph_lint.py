@@ -164,8 +164,29 @@ def build_serve_decode(fixture=None):
                                 engine.example_decode_args([6, 4])]
 
 
+def build_serve_verify(fixture=None):
+    """The speculative-decoding verify step (``[batch, k+1]`` window)
+    against two different slot-length vectors — the ISSUE-13 analogue of
+    the serve-decode gate: lengths live inside the static cache, so both
+    example signatures are identical and the shape-churn rules must stay
+    silent (one compile serves every acceptance pattern)."""
+    del fixture  # no optimizer in the serving path
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=2, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    engine = GenerationEngine(GPTForCausalLM(cfg), max_batch=2, max_len=32,
+                              prefill_buckets=(8,), spec_k=3)
+    return engine.verify_step, [engine.example_verify_args([5, 3]),
+                                engine.example_verify_args([9, 6])]
+
+
 ZOO = {"mlp": build_mlp, "resnet": build_resnet, "bert": build_bert,
-       "serve-decode": build_serve_decode}
+       "serve-decode": build_serve_decode, "serve-verify": build_serve_verify}
 
 
 def lint_zoo(models, fixture=None, run_steps=0, out=sys.stdout):
@@ -176,8 +197,8 @@ def lint_zoo(models, fixture=None, run_steps=0, out=sys.stdout):
     results = []
     for name in models:
         step, batches = ZOO[name](fixture=fixture)
-        x, y = batches[0]
-        report = analysis.lint_step(step, x, y, extra_args=batches[1:])
+        args = batches[0]  # (x, y) train pairs or n-ary serving args
+        report = analysis.lint_step(step, *args, extra_args=batches[1:])
         print(f"\n== {name} ({step.name}) ==", file=out)
         print(report.table(), file=out)
         if run_steps > 0:
@@ -187,7 +208,7 @@ def lint_zoo(models, fixture=None, run_steps=0, out=sys.stdout):
             telemetry.enable()
             try:
                 for _ in range(run_steps):
-                    step(x, y)
+                    step(*args)
                 checks = analysis.crosscheck_telemetry(report)
             finally:
                 telemetry.disable()

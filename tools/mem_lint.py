@@ -132,8 +132,7 @@ def build_serve_decode(fixture=None):
     model.eval()
     eng = GenerationEngine(model, max_batch=4, max_len=128,
                            freeze_weights=False)
-    tokens, cache = eng.example_decode_args([3, 5])
-    return eng.decode_step, (tokens, cache), None, True
+    return eng.decode_step, tuple(eng.example_decode_args([3, 5])), None, True
 
 
 def build_undonated_longctx(fixture=None):

@@ -122,10 +122,12 @@ PYEOF
   python tools/mem_report.py "$SMOKE_DIR/devprof_smoke.jsonl"
   # graph-lint gate: statically lint the bench-zoo train steps (resnet +
   # bert, no device execution) plus the serving tier's batched decode
-  # step — any error-severity finding (a state-pytree retrace hazard, or
-  # a kv-cache-concat/shape-churn finding on the decode step, which must
-  # be shape-stable across positions) fails the runner via exit status
-  JAX_PLATFORMS=cpu python tools/graph_lint.py --models resnet bert serve-decode \
+  # and speculative-verify steps — any error-severity finding (a
+  # state-pytree retrace hazard, or a kv-cache-concat/shape-churn finding
+  # on either serving step, which must be shape-stable across positions
+  # and acceptance patterns) fails the runner via exit status
+  JAX_PLATFORMS=cpu python tools/graph_lint.py \
+    --models resnet bert serve-decode serve-verify \
     --jsonl "$SMOKE_DIR/graph_lint.jsonl"
   # shard-lint gate (ISSUE 7): abstract SPMD propagation over the MULTICHIP
   # zoo — the dp×mp + MoE configs must lint with zero error findings AND
@@ -145,17 +147,21 @@ PYEOF
   # flagged over its injected budget (exit 1); --smoke runs both legs
   JAX_PLATFORMS=cpu python tools/mem_lint.py --smoke
   # serving smoke (tiny gpt, CPU): continuous batching vs sequential
-  # decode through the static KV cache; bench_serve --smoke hard-asserts
-  # the telemetry contract — serve.tokens_per_s / serve.p95_latency_s
-  # present, decode compiled EXACTLY once, prefill <= once per length
-  # bucket, zero shape-churn/kv-cache lint findings on the decode step
+  # decode through the static KV cache, speculative decoding + chunked
+  # prefill ON (ISSUE 13 defaults); bench_serve --smoke hard-asserts the
+  # telemetry contract — serve.tokens_per_s / serve.p95_latency_s
+  # present, decode/verify/chunk each compiled EXACTLY once, prefill <=
+  # once per length bucket, recompile_count 0 against the declared
+  # variants, speculation actually engaged, zero shape-churn findings
   JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke \
     --artifact "$SMOKE_DIR/serve_smoke.json"
-  # serving chaos gate (ISSUE 10): flood the scheduler under injected
-  # OOM/transient-error/stall faults and hard-assert the resilience
-  # contract — every request ends with exactly one terminal
-  # finish_reason, survivors match the clean run token-for-token, the
-  # overload SLOs page, and post-chaos throughput recovers to >=90%
+  # serving chaos gate (ISSUE 10 + 13): flood the scheduler (speculation
+  # + chunked prefill ON) under injected OOM/transient-error/stall plus
+  # draft and mid-verify faults, and hard-assert the resilience contract
+  # — every request ends with exactly one terminal finish_reason,
+  # survivors match the PLAIN-GREEDY clean run token-for-token, verify
+  # faults degrade to plain ticks, the overload SLOs page, and
+  # post-chaos throughput recovers to >=90%
   JAX_PLATFORMS=cpu python tools/chaos_serve.py --smoke
   # checkpoint-doctor smoke: write two CheckpointManager steps (one torn
   # via fault injection), then exercise the verify/inspect/prune CLI —
@@ -228,7 +234,8 @@ finally:
 PYEOF
   # bench-history regression sentinel (ISSUE 8): the checked-in
   # BENCH/SERVE/MULTICHIP rounds must pass the noise-aware baseline
-  # check, and an injected 20% tokens/sec drop MUST be flagged
+  # check, and an injected 25% tokens/sec drop MUST be flagged (clears
+  # the 20% noise-cap so a jittery history can't absorb the self-test)
   python tools/bench_sentinel.py --smoke
   rm -rf "$SMOKE_DIR"
 fi
