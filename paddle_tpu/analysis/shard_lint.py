@@ -196,17 +196,26 @@ class PredictedCollective:
                 f"{self.bytes:.0f}B x{self.count})")
 
 
+#: constraints written by the framework's own sharding-policy modules are
+#: placement decisions, not accidents — the ZeRO param all-gather
+#: (distributed/sharding/zero.py) deliberately constrains the updated shard
+#: back to its replicated spec. These reshards stay PRICED (they are real
+#: wire bytes) but ``spmd-implicit-resharding`` must not error on them.
+_POLICY_FILES = frozenset({"zero.py", "group_sharded.py"})
+
+
 class Reshard:
     """A propagated sharding disagreeing with a downstream consumer
     (``with_sharding_constraint``, dot contraction, elementwise merge) —
     the event the ``spmd-implicit-resharding`` / ``spmd-sharding-mismatch``
-    rules report."""
+    rules report. ``declared`` marks reshards issued by the framework's
+    sharding-policy modules (see ``_POLICY_FILES``)."""
 
     __slots__ = ("kind", "axes", "bytes", "where", "from_spec", "to_spec",
-                 "path", "op")
+                 "path", "op", "declared")
 
     def __init__(self, kind, axes, nbytes, where="", from_spec=(),
-                 to_spec=(), path="", op="all-gather"):
+                 to_spec=(), path="", op="all-gather", declared=False):
         self.kind = kind            # "constraint" | "dot" | "elementwise"
         self.axes = tuple(axes)
         self.bytes = float(nbytes)
@@ -215,13 +224,14 @@ class Reshard:
         self.to_spec = to_spec
         self.path = path            # input pytree path when the value IS an
         self.op = op                # invar (first-use mismatch), else ""
+        self.declared = bool(declared)
 
     def as_dict(self):
         return {"kind": self.kind, "axes": list(self.axes),
                 "bytes": self.bytes, "where": self.where,
                 "from_spec": _spec_str(self.from_spec),
                 "to_spec": _spec_str(self.to_spec), "path": self.path,
-                "op": self.op}
+                "op": self.op, "declared": self.declared}
 
 
 def _spec_str(spec):
@@ -474,6 +484,7 @@ class _Walker:
                 if a in out_axes and a not in target[d]:
                     moved.add(a)
         path = _path_of(var_paths, eqn.invars[0]) if eqn.invars else ""
+        declared = where.split(":", 1)[0] in _POLICY_FILES
         if removed:
             nbytes = self._gather_bytes(aval, in_spec, removed)
             self._emit("all-gather", removed, nbytes, where,
@@ -483,7 +494,8 @@ class _Walker:
             self.ctx.reshards.append(Reshard(
                 "constraint", self._mesh_order(removed),
                 multiplier * nbytes, where=where, from_spec=in_spec,
-                to_spec=target, path=path, op="all-gather"))
+                to_spec=target, path=path, op="all-gather",
+                declared=declared))
         if moved:
             s = self._group_size(moved)
             nbytes = (self._ring("all-to-all", s)
@@ -495,7 +507,7 @@ class _Walker:
             self.ctx.reshards.append(Reshard(
                 "constraint", self._mesh_order(moved), multiplier * nbytes,
                 where=where, from_spec=in_spec, to_spec=target, path=path,
-                op="all-to-all"))
+                op="all-to-all", declared=declared))
         return _dedupe_axes(target)
 
     def _dot(self, eqn, ins, where, var_paths, multiplier):
@@ -716,6 +728,11 @@ class _Walker:
         shape): per-dim union of operand shardings; a genuine conflict
         (two different non-empty axis sets on one dim) is an implicit
         reshard of the minority operand. Everything else: replicated."""
+        if eqn.primitive.name == "optimization_barrier":
+            # pure scheduling fence (ZeRO bucketed-overlap chains grads
+            # through it): multi-in/multi-out identity — dropping specs
+            # here would predict phantom gathers in the sharded update
+            return [tuple(sp) for sp in ins]
         if not eqn.outvars:
             return []
         out_aval = eqn.outvars[0].aval

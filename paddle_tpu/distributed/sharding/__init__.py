@@ -1,3 +1,11 @@
 from .group_sharded import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .zero import (  # noqa: F401
+    ShardedOptimizer,
+    int8_all_gather,
+    int8_all_reduce,
+    int8_reduce_scatter,
+)
 
-__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "ShardedOptimizer", "int8_all_reduce", "int8_reduce_scatter",
+           "int8_all_gather"]

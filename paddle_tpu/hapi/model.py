@@ -70,13 +70,33 @@ class Model:
     # setup
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
-                graph_lint=None):
+                graph_lint=None, zero=None):
         """Reference ``model.py:1499``.
 
         ``graph_lint=True`` statically lints the compiled train step against
         the first batch of the first fit (``paddle_tpu.analysis``) and warns
         on findings; ``None`` (default) follows the process-wide
-        ``analysis.enable_lint_on_compile()`` flag, ``False`` disables."""
+        ``analysis.enable_lint_on_compile()`` flag, ``False`` disables.
+
+        ``zero`` shards the weight update over a mesh data axis
+        (``distributed.sharding.ShardedOptimizer``): ``zero="dp"`` names
+        the axis, ``zero=True`` uses the default mesh's first axis, and a
+        dict forwards configs, e.g. ``{"axis": "dp", "quantize": "int8"}``
+        for the int8 error-feedback param all-gather."""
+        if zero and optimizer is not None:
+            from ..distributed.mesh import get_mesh
+            from ..distributed.sharding import ShardedOptimizer
+
+            cfg = dict(zero) if isinstance(zero, dict) else {}
+            mesh = cfg.pop("mesh", None) or get_mesh()
+            if mesh is None:
+                raise ValueError(
+                    "prepare(zero=...) needs a mesh: build one with "
+                    "distributed.mesh.build_mesh({'dp': n}) first")
+            axis = cfg.pop("axis", None) or (
+                zero if isinstance(zero, str) else mesh.axis_names[0])
+            optimizer = ShardedOptimizer(optimizer, axis=axis, mesh=mesh,
+                                         **cfg)
         self._optimizer = optimizer
         if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
             raise TypeError("loss must be a Layer or callable")
@@ -121,7 +141,11 @@ class Model:
             return [loss] + outs
 
         step._n_inputs = self._n_inputs_cached
-        self._train_step = CompiledStep(step, stateful=[net, opt],
+        # thread the INNER optimizer when opt is a ShardedOptimizer
+        # wrapper: the wrapper owns no arrays, the inner holds the
+        # (sharded) accumulators
+        inner = getattr(opt, "_inner_opt", opt)
+        self._train_step = CompiledStep(step, stateful=[net, inner],
                                         donate_state=True)
         return self._train_step
 

@@ -54,6 +54,13 @@ class Optimizer:
         # (ZeRO sharding / offload — distributed/sharding/group_sharded.py);
         # avoids ever materializing a full-size replicated buffer
         self._accumulator_transform = None
+        # ZeRO sharded-update seams (distributed/sharding/zero.py):
+        # _grad_transform(p, gv) runs before the update rule — the
+        # reduce-scatter point; _param_transform(p, value) runs on the
+        # updated value after the (possibly fp32-master) write-back — the
+        # all-gather point. Both None outside a sharded wrapper.
+        self._grad_transform = None
+        self._param_transform = None
         # fp32 master weights + fp32 moments for low-precision params
         # (reference adam_op multi-precision path / amp O2 master weights)
         self._multi_precision = bool(multi_precision)
@@ -213,6 +220,8 @@ class Optimizer:
                 gv = gv + reg._coeff * p._value
             else:
                 gv = self._apply_decay(p, gv)
+            if self._grad_transform is not None:
+                gv = self._grad_transform(p, gv)
             param_lr = getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             self._step_one(p, gv, lr * param_lr)
 
@@ -232,6 +241,10 @@ class Optimizer:
         else:
             new_val = self._update_param(p, gv, lr_eff)
             p._value = new_val.astype(p._value.dtype)
+        if self._param_transform is not None:
+            # the sharded master/moments stay exact on their shard; only
+            # the working copy is re-gathered (int8 wire optional)
+            p._value = self._param_transform(p, p._value)
 
     def _update_param(self, p, grad, lr):
         raise NotImplementedError
@@ -275,6 +288,8 @@ class Optimizer:
             if g is None:
                 continue
             gv = g._value if isinstance(g, Tensor) else g
+            if self._grad_transform is not None:
+                gv = self._grad_transform(p, gv)
             self._step_one(p, gv, lr)
 
     # -- state dict ----------------------------------------------------------

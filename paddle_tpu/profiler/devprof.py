@@ -218,6 +218,7 @@ _HLO_FACTORS = {
     "reduce-scatter": lambda s: float(s - 1),      # result is the local shard
     "all-to-all": lambda s: (s - 1) / s,
     "collective-permute": lambda s: 1.0,
+    "collective-broadcast": lambda s: (s - 1) / s,  # root ships to s-1 peers
 }
 
 _HLO_DTYPE_BYTES = {
@@ -231,7 +232,8 @@ _HLO_DTYPE_BYTES = {
 }
 
 _HLO_OP_RE = re.compile(
-    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)"
+    r"\b(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute"
+    r"|collective-broadcast)"
     r"(-start)?\(")
 _HLO_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _HLO_GROUPS_RE = re.compile(
@@ -426,6 +428,15 @@ def collectives_from_hlo(hlo_text, mesh=None):
         # async *-start ops repeat the buffer in their result tuple; take
         # the largest element instead of double counting
         nbytes = max(shapes) if is_start else sum(shapes)
+        if is_start and op == "reduce-scatter":
+            # For reduce-scatter the largest tuple element of the -start op is
+            # the *input* (size x result), but _HLO_FACTORS prices the result
+            # shard.  Rescale so sync and async forms price identically.
+            gm0 = _HLO_GROUPS_RE.search(line)
+            g0 = _decode_groups(gm0.group(1)) if gm0 else None
+            sz = len(g0[0]) if g0 else (n_part or 2)
+            if sz > 1:
+                nbytes = nbytes // sz
         gm = _HLO_GROUPS_RE.search(line)
         groups = _decode_groups(gm.group(1)) if gm else None
         pairwise = op == "collective-permute"

@@ -150,12 +150,15 @@ def test_real_history_loads_and_passes_clean():
 
 
 def test_real_history_flags_injected_20pct_drop():
+    # the serve series carries the live tokens_per_sec history — the bench
+    # series' tokens_per_sec ended at r05 (r14 onward is CPU-measured and
+    # deliberately omits parsed.value; see BENCH_r14.json's note)
     series = bench_sentinel.load_series(REPO_ROOT)
-    injected = bench_sentinel.inject_round(series, "bench",
+    injected = bench_sentinel.inject_round(series, "serve",
                                            "tokens_per_sec", 0.8)
     f = bench_sentinel.compare(injected)
     regs = _regressions(f)
-    assert any(r["series"] == "bench" and r["metric"] == "tokens_per_sec"
+    assert any(r["series"] == "serve" and r["metric"] == "tokens_per_sec"
                for r in regs), bench_sentinel.build_table(f, verbose=True)
     # the untouched metrics still pass
     assert all(r["metric"] == "tokens_per_sec" for r in regs)
@@ -187,7 +190,7 @@ def test_cli_inject_fails_and_dumps_json(tmp_path, capsys):
     out_json = tmp_path / "findings.json"
     rc = bench_sentinel.main([
         "--root", REPO_ROOT,
-        "--inject", "bench:tokens_per_sec=0.8",
+        "--inject", "serve:tokens_per_sec=0.8",
         "--json", str(out_json)])
     assert rc == 1
     table = capsys.readouterr().out

@@ -335,6 +335,88 @@ def test_hlo_explicit_brace_groups_attributed_per_axis():
     assert list(st2.as_dict()) == ["dp"]
 
 
+def test_hlo_reduce_scatter_sync_prices_result_shard():
+    """Ring model: each device ships (s-1) result-shard-sized chunks. The
+    sync op's shape IS the local shard."""
+    mesh = build_mesh({"dp": 4})
+    line = ("%reduce-scatter = f32[4,32]{1,0} reduce-scatter(f32[16,32]{1,0} "
+            "%param.1), channel_id=2, replica_groups={{0,1,2,3}}, "
+            "use_global_device_ids=true, dimensions={0}, to_apply=%add")
+    st = devprof.collectives_from_hlo(line, mesh=mesh).as_dict()
+    assert st["dp"]["prims"] == {"reduce-scatter": 1}
+    assert st["dp"]["bytes"] == 3 * (4 * 32 * 4)  # (s-1) x result shard
+
+
+def test_hlo_reduce_scatter_start_rescaled_to_shard():
+    """Regression: the async -start op's result tuple carries the INPUT
+    buffer (s x the shard) as its largest element; pricing must rescale by
+    the group size so sync and async forms agree."""
+    mesh = build_mesh({"dp": 4})
+    line = ("%reduce-scatter-start = ((f32[16,32]{1,0}), f32[4,32]{1,0}) "
+            "reduce-scatter-start(f32[16,32]{1,0} %param.1), channel_id=2, "
+            "replica_groups={{0,1,2,3}}, use_global_device_ids=true, "
+            "dimensions={0}, to_apply=%add")
+    st = devprof.collectives_from_hlo(line, mesh=mesh).as_dict()
+    assert st["dp"]["bytes"] == 3 * (4 * 32 * 4)  # == the sync price
+
+
+def test_hlo_all_gather_start_max_not_sum():
+    """The -start tuple repeats input+output; summing would double-count.
+    max picks the gathered result, priced (s-1)/s."""
+    mesh = build_mesh({"dp": 4})
+    sync = ("%all-gather = f32[16,32]{1,0} all-gather(f32[4,32]{1,0} "
+            "%param.1), channel_id=3, replica_groups={{0,1,2,3}}, "
+            "use_global_device_ids=true, dimensions={0}")
+    start = ("%all-gather-start = (f32[4,32]{1,0}, f32[16,32]{1,0}) "
+             "all-gather-start(f32[4,32]{1,0} %param.1), channel_id=3, "
+             "replica_groups={{0,1,2,3}}, use_global_device_ids=true, "
+             "dimensions={0}")
+    want = (3 / 4) * (16 * 32 * 4)
+    assert devprof.collectives_from_hlo(
+        sync, mesh=mesh).as_dict()["dp"]["bytes"] == want
+    assert devprof.collectives_from_hlo(
+        start, mesh=mesh).as_dict()["dp"]["bytes"] == want
+
+
+def test_hlo_all_reduce_start_matches_sync():
+    mesh = build_mesh({"dp": 2})
+    sync = ("%all-reduce = f32[8,32]{1,0} all-reduce(f32[8,32]{1,0} "
+            "%dot.1), channel_id=1, replica_groups={{0,1}}, "
+            "use_global_device_ids=true, to_apply=%add")
+    start = ("%all-reduce-start = (f32[8,32]{1,0}, f32[8,32]{1,0}) "
+             "all-reduce-start(f32[8,32]{1,0} %dot.1), channel_id=1, "
+             "replica_groups={{0,1}}, use_global_device_ids=true, "
+             "to_apply=%add")
+    want = (2 * 1 / 2) * (8 * 32 * 4)  # 2(s-1)/s, s=2
+    assert devprof.collectives_from_hlo(
+        sync, mesh=mesh).as_dict()["dp"]["bytes"] == want
+    assert devprof.collectives_from_hlo(
+        start, mesh=mesh).as_dict()["dp"]["bytes"] == want
+
+
+def test_hlo_collective_broadcast_decoded():
+    """collective-broadcast (GSPMD emits it for replicating a sharded
+    buffer) must be decoded, not silently dropped from the comm price."""
+    mesh = build_mesh({"dp": 4})
+    line = ("%collective-broadcast = f32[8,32]{1,0} collective-broadcast("
+            "f32[8,32]{1,0} %param.1), channel_id=5, "
+            "replica_groups={{0,1,2,3}}")
+    st = devprof.collectives_from_hlo(line, mesh=mesh).as_dict()
+    assert st["dp"]["prims"] == {"collective-broadcast": 1}
+    assert st["dp"]["bytes"] == (3 / 4) * (8 * 32 * 4)
+
+
+def test_hlo_int8_wire_priced_at_one_byte():
+    """The int8 EF all-gather ships s8 on the wire — the pricer must use
+    the element size from the HLO dtype, not assume fp32."""
+    mesh = build_mesh({"dp": 4})
+    line = ("%all-gather.9 = s8[16,256]{1,0} all-gather(s8[4,256]{1,0} "
+            "%bitcast.3), channel_id=7, replica_groups=[1,4]<=[4], "
+            "use_global_device_ids=true, dimensions={0}")
+    st = devprof.collectives_from_hlo(line, mesh=mesh).as_dict()
+    assert st["dp"]["bytes"] == (3 / 4) * (16 * 256 * 1)
+
+
 # ---------------------------------------------------------------------------
 # pipeline bubble + straggler metrics
 # ---------------------------------------------------------------------------

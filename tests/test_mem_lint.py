@@ -172,6 +172,34 @@ def test_crosscheck_dp_mp_zoo(cli):
     assert "0 crosscheck disagreement(s)" in out.stdout
 
 
+@needs_8_devices
+def test_zero_sharded_update_cuts_predicted_peak(cli):
+    """dp-plain vs dp-zero zoo pair (ISSUE 14): the ZeRO sharded weight
+    update must drop the PREDICTED per-device peak by at least the
+    sharded optimizer-state bytes — 12 B/param (fp32 master + moment1 +
+    moment2 under bf16 multi_precision AdamW) scaled by (dp-1)/dp — and
+    the ``spmd-replicated-optimizer-state`` rule flips from firing on the
+    plain step to quiet on the sharded one."""
+    buf = io.StringIO()
+    res = {name: (report, tl)
+           for name, report, tl, _ in cli.lint_zoo(["dp-plain", "dp-zero"],
+                                                   out=buf)}
+    rep_plain, tl_plain = res["dp-plain"]
+    rep_zero, tl_zero = res["dp-zero"]
+    assert rep_plain.by_rule("spmd-replicated-optimizer-state")
+    assert not rep_zero.by_rule("spmd-replicated-optimizer-state")
+    assert not rep_zero.by_rule("hbm-const-folded")  # state stays threaded
+
+    dp = 8
+    n_params = 256 * 1024 + 1024 + 1024 * 256 + 256  # the zoo MLP
+    acc_drop = 12 * n_params * (dp - 1) // dp
+    drop = tl_plain.peak_bytes - tl_zero.peak_bytes
+    # at least the accumulator shards leave the peak; the ceiling admits
+    # the sharded gradients/update temps that ride along (~1.43x observed)
+    assert drop >= acc_drop, (drop, acc_drop)
+    assert drop <= 1.6 * acc_drop, (drop, acc_drop)
+
+
 def test_crosscheck_serve_decode_zoo(cli):
     """gpt2-style serve decode: the static-shape KV-cache step's predicted
     peak agrees with the measured one, and the padded example lengths

@@ -135,6 +135,74 @@ def build_serve_decode(fixture=None):
     return eng.decode_step, tuple(eng.example_decode_args([3, 5])), None, True
 
 
+def _build_dp_adam(zero):
+    """Shared builder for the ZeRO optimizer-state accounting pair: a bf16
+    MLP under a pure-dp mesh with AdamW(multi_precision=True) — 12 bytes of
+    fp32 optimizer state per param (master + moment1 + moment2). ``dp-plain``
+    keeps that state replicated; ``dp-zero`` wraps the optimizer in
+    ``ShardedOptimizer`` so every accumulator lives at 1/dp per replica —
+    the predicted peak must drop by ~the sharded accumulator bytes
+    (pinned in tests/test_mem_lint.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharding import ShardedOptimizer
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.utils import unique_name
+
+    mesh = build_mesh({"dp": 8})
+    with unique_name.guard():
+        paddle.seed(0)
+        l1 = paddle.nn.Linear(256, 1024)
+        l2 = paddle.nn.Linear(1024, 256)
+    rep = NamedSharding(mesh, P())
+    for lyr in (l1, l2):
+        for p in lyr.parameters():
+            p._value = jax.device_put(p._value.astype(jnp.bfloat16), rep)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2, multi_precision=True,
+        parameters=list(l1.parameters()) + list(l2.parameters()))
+    if zero:
+        opt = ShardedOptimizer(opt, axis="dp", mesh=mesh)
+    stateful_opt = opt._inner_opt if zero else opt
+
+    def train_step(x, y):
+        h = paddle.nn.functional.relu(l1(x))
+        out = l2(h)
+        loss = ((out - y).astype(jnp.float32) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "dp_zero_step" if zero else "dp_plain_step"
+    step = CompiledStep(train_step, stateful=[l1, l2, stateful_opt],
+                        donate_state=True)
+    rng = np.random.RandomState(4)
+    put = jax.device_put
+    x = Tensor(put(jnp.asarray(rng.randn(64, 256), jnp.bfloat16),
+                   NamedSharding(mesh, P("dp", None))))
+    y = Tensor(put(jnp.asarray(rng.randn(64, 256), jnp.bfloat16),
+                   NamedSharding(mesh, P("dp", None))))
+    # static-only: the pair exists for the PREDICTED optimizer-state
+    # accounting (tests/test_mem_lint.py pins the dp-fold peak drop); the
+    # step is optimizer-temp dominated, where the fusion-blind upper-bound
+    # model legitimately over-predicts XLA's fused update kernel
+    return step, (x, y), mesh, False
+
+
+def build_dp_plain(fixture=None):
+    return _build_dp_adam(zero=False)
+
+
+def build_dp_zero(fixture=None):
+    return _build_dp_adam(zero=True)
+
+
 def build_undonated_longctx(fixture=None):
     """The fixture: a long-context attention forward whose weights are NOT
     donated (``donate_state=False``) — the [b, h, q, k] score matrix plus
@@ -189,6 +257,8 @@ def build_undonated_longctx(fixture=None):
 ZOO = {
     "dp-mp": build_dp_mp,
     "serve-decode": build_serve_decode,
+    "dp-plain": build_dp_plain,
+    "dp-zero": build_dp_zero,
 }
 
 FIXTURES = {
@@ -245,7 +315,7 @@ def lint_zoo(models, fixture=None, measure=False, capacity=None,
 def run(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", nargs="+",
-                    default=["dp-mp", "serve-decode"],
+                    default=["dp-mp", "serve-decode", "dp-plain", "dp-zero"],
                     choices=sorted(ZOO))
     ap.add_argument("--jsonl", default=None,
                     help="write one JSON object per finding to this path")

@@ -129,13 +129,15 @@ PYEOF
   JAX_PLATFORMS=cpu python tools/graph_lint.py \
     --models resnet bert serve-decode serve-verify \
     --jsonl "$SMOKE_DIR/graph_lint.jsonl"
-  # shard-lint gate (ISSUE 7): abstract SPMD propagation over the MULTICHIP
-  # zoo — the dp×mp + MoE configs must lint with zero error findings AND
-  # the predicted per-axis collective bytes must agree with the
-  # compiled-HLO measurement (--measure; exit 1 on either), while the
-  # injected mismatched-constraint fixture MUST be flagged (exit 1)
-  JAX_PLATFORMS=cpu python tools/shard_lint.py --models dp-mp moe --measure \
-    --jsonl "$SMOKE_DIR/shard_lint.jsonl"
+  # shard-lint gate (ISSUE 7 + 14): abstract SPMD propagation over the
+  # MULTICHIP zoo — the dp×mp + MoE + dp-zero (ZeRO sharded update)
+  # configs must lint with zero error findings AND the predicted per-axis
+  # collective bytes must agree with the compiled-HLO measurement
+  # (--measure; exit 1 on either; dp-zero also proves the deliberate
+  # param all-gather is a declared reshard, not an implicit one), while
+  # the injected mismatched-constraint fixture MUST be flagged (exit 1)
+  JAX_PLATFORMS=cpu python tools/shard_lint.py --models dp-mp moe dp-zero \
+    --measure --jsonl "$SMOKE_DIR/shard_lint.jsonl"
   if JAX_PLATFORMS=cpu python tools/shard_lint.py --models dp-mp \
       --fixture mismatched-constraint > /dev/null 2>&1; then
     echo "shard_lint missed the mismatched-constraint fixture" >&2; exit 1
@@ -146,6 +148,22 @@ PYEOF
   # under-predicting), while the undonated long-context fixture MUST be
   # flagged over its injected budget (exit 1); --smoke runs both legs
   JAX_PLATFORMS=cpu python tools/mem_lint.py --smoke
+  # ZeRO dp-parity gate (ISSUE 14): the dp=2 sharded-update smoke bench
+  # must hold loss parity against replicated Adam (--parity asserts it),
+  # cut per-replica optimizer-state bytes ~dp-fold, and emit comm
+  # telemetry (comm_fraction + comm.bytes.dp) for the bench artifact
+  python bench.py --dp 2 --zero --parity \
+    --artifact "$SMOKE_DIR/zero_bench.json"
+  python - "$SMOKE_DIR/zero_bench.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["parity"]["max_rel"] < 1e-5, doc["parity"]
+sb = doc["state_bytes"]
+assert sb["ratio"] and sb["ratio"] > 1.9, sb
+tel = doc["telemetry"]
+assert tel.get("comm_fraction") is not None, tel
+assert tel.get("comm_bytes_by_axis", {}).get("dp"), tel
+PYEOF
   # serving smoke (tiny gpt, CPU): continuous batching vs sequential
   # decode through the static KV cache, speculative decoding + chunked
   # prefill ON (ISSUE 13 defaults); bench_serve --smoke hard-asserts the

@@ -12,7 +12,8 @@ collective bytes, and (with ``--jsonl``) emits one JSON object per finding.
 annotations.
 
 ``--measure`` additionally compiles each config that this host's backend
-supports (dp-mp, moe on XLA:CPU) through ``profiler.devprof`` and prints
+supports (dp-mp, moe, dp-zero on XLA:CPU) through ``profiler.devprof``
+and prints
 the predicted-vs-HLO-measured crosscheck rows
 (``analysis.crosscheck_comm`` — the accuracy loop; within 10%, exact for
 explicit shard_map collectives).
@@ -27,7 +28,7 @@ Exit status: 1 when any finding at/above ``--fail-on`` severity survived
 
 Usage:
     JAX_PLATFORMS=cpu python tools/shard_lint.py
-        [--models dp-mp dp-mp-sep sharding-pp moe] [--jsonl PATH]
+        [--models dp-mp dp-mp-sep sharding-pp moe dp-zero] [--jsonl PATH]
         [--format table|sarif] [--fixture mismatched-constraint]
         [--measure] [--fail-on error|warning|never]
 """
@@ -308,11 +309,68 @@ def build_moe(fixture=None):
     return step, (x,), mesh, True  # measurable on XLA:CPU
 
 
+def build_dp_zero(fixture=None):
+    """Pure-dp ZeRO sharded weight update (distributed/sharding/zero.py):
+    grads constrained to each param's 1/dp shard at the optimizer, AdamW
+    moments + step on the shard, params constrained back to replicated —
+    the partitioner materializes the reduce-scatter/all-gather pair (on
+    XLA:CPU: all-reduce + fused local slice, priced identically). The
+    ``spmd-replicated-optimizer-state`` rule must stay quiet here, and the
+    predicted dp bytes must match the compiled HLO within 10%."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_mesh
+    from paddle_tpu.distributed.sharding import ShardedOptimizer
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.utils import unique_name
+
+    mesh = build_mesh({"dp": 8})
+    with unique_name.guard():
+        paddle.seed(0)
+        l1 = paddle.nn.Linear(64, 256)
+        l2 = paddle.nn.Linear(256, 64)
+    rep = NamedSharding(mesh, P())
+    for lyr in (l1, l2):
+        for p in lyr.parameters():
+            p._value = jax.device_put(p._value, rep)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2,
+        parameters=list(l1.parameters()) + list(l2.parameters()))
+    opt = ShardedOptimizer(opt, axis="dp", mesh=mesh)
+
+    def train_step(x, y):
+        h = paddle.nn.functional.relu(l1(x))
+        if fixture == "mismatched-constraint":
+            h._value = _mismatch(h._value, mesh, "dp")
+        out = l2(h)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "dp_zero_train_step"
+    step = CompiledStep(train_step, stateful=[l1, l2, opt._inner_opt],
+                        donate_state=True)
+    rng = np.random.RandomState(3)
+    put = jax.device_put
+    x = Tensor(put(jnp.asarray(rng.randn(32, 64), jnp.float32),
+                   NamedSharding(mesh, P("dp", None))))
+    y = Tensor(put(jnp.asarray(rng.randn(32, 64), jnp.float32),
+                   NamedSharding(mesh, P("dp", None))))
+    return step, (x, y), mesh, True  # measurable on XLA:CPU
+
+
 ZOO = {
     "dp-mp": build_dp_mp,
     "dp-mp-sep": build_dp_mp_sep,
     "sharding-pp": build_sharding_pp,
     "moe": build_moe,
+    "dp-zero": build_dp_zero,
 }
 
 
@@ -354,7 +412,8 @@ def lint_zoo(models, fixture=None, measure=False, out=sys.stdout):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", nargs="+",
-                    default=["dp-mp", "dp-mp-sep", "sharding-pp", "moe"],
+                    default=["dp-mp", "dp-mp-sep", "sharding-pp", "moe",
+                             "dp-zero"],
                     choices=sorted(ZOO))
     ap.add_argument("--jsonl", default=None,
                     help="write one JSON object per finding to this path")
