@@ -45,6 +45,13 @@ from .mem_lint import (  # noqa: F401
     analyze_memory,
 )
 from .shard_lint import ShardingAnalysis, analyze_sharding  # noqa: F401
+from . import remat_plan  # noqa: F401
+from .remat_plan import (  # noqa: F401
+    AutoRematReport,
+    RematPlan,
+    auto_remat,
+    plan_remat,
+)
 
 __all__ = [
     "SEVERITIES", "Finding", "LintReport", "StepGraph", "LINT_DEFAULTS",
@@ -54,6 +61,8 @@ __all__ = [
     "RULES", "register_rule", "rule_ids",
     "shard_lint", "ShardingAnalysis", "analyze_sharding",
     "mem_lint", "MemoryTimeline", "analyze_memory", "MEM_LINT_DEFAULTS",
+    "remat_plan", "RematPlan", "AutoRematReport", "plan_remat",
+    "auto_remat",
     "enable_lint_on_compile", "lint_on_compile_enabled", "autolint",
 ]
 

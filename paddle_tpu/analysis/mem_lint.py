@@ -241,8 +241,13 @@ class MemoryTimeline:
             d.death = end
             self.alias_bytes += d.nbytes
 
-    def _sweep(self, death_override=None):
-        """Event sweep → (live_bytes list, peak, peak_index)."""
+    def _sweep(self, death_override=None, relive=None):
+        """Event sweep → (live_bytes list, peak, peak_index).
+
+        ``relive`` maps buffer key → a step index at which the buffer is
+        briefly live AGAIN after its (overridden) death — the recompute
+        window of a rematerialized activation: freed after the forward,
+        re-allocated at its backward consumer."""
         n = len(self.steps)
         if n == 0:
             resident = sum(b.eff_bytes for b in self.buffers)
@@ -256,6 +261,11 @@ class MemoryTimeline:
             if death_override and b.key in death_override:
                 death = death_override[b.key]
             s = max(b.birth, 0)
+            if relive and b.key in relive:
+                r = min(max(relive[b.key], 0), n - 1)
+                if r > min(death, n - 1):
+                    delta[r] += eb
+                    delta[r + 1] -= eb
             if death < s:
                 continue
             e = min(death, n - 1)
@@ -317,6 +327,26 @@ class MemoryTimeline:
         if not override:
             return 0.0
         _, new_peak, _ = self._sweep(death_override=override)
+        return max(self.peak_bytes - new_peak, 0.0)
+
+    def delta_if_remat(self, keys):
+        """Predicted peak reduction if the temp buffer(s) at ``keys`` were
+        rematerialized: freed right after birth (nothing saved for the
+        backward) and briefly re-allocated at the last consumer (the
+        recompute window). The re-live event keeps this honest — freeing
+        a buffer whose backward consumer sits AT the peak wins nothing."""
+        if isinstance(keys, (int, np.integer)):
+            keys = (keys,)
+        override, relive = {}, {}
+        for key in keys:
+            b = self.buffers[int(key)]
+            if b.kind != "temp" or b.is_output or b.aliases is not None:
+                continue
+            override[b.key] = max(b.birth, 0)
+            relive[b.key] = max(b.last_use, b.birth, 0)
+        if not override:
+            return 0.0
+        _, new_peak, _ = self._sweep(death_override=override, relive=relive)
         return max(self.peak_bytes - new_peak, 0.0)
 
     def long_lived(self, min_bytes, min_span):

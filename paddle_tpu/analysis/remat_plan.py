@@ -1,0 +1,341 @@
+"""Selective-remat autopilot: pick jax.checkpoint sites from the liveness
+timeline so the predicted HBM peak fits a budget.
+
+The memory lint (:mod:`.mem_lint`) already *names* the problem: the
+``hbm-remat-candidate`` rule lists the long-lived activations a train
+step's backward holds across the peak. This module closes the loop:
+
+* :func:`candidate_sites` groups those buffers by source provenance (the
+  N identical decoder blocks of a transformer share one ``where`` — one
+  site per producing region, not per buffer);
+* :func:`plan_remat` greedily picks the cheapest site set whose combined
+  :meth:`~.mem_lint.MemoryTimeline.delta_if_remat` brings the predicted
+  peak under the budget. "Cheapest" uses recomputed-bytes as the FLOP
+  proxy: the repeated blocks are homogeneous, so re-materializing fewer
+  bytes re-runs proportionally less forward;
+* :func:`auto_remat` APPLIES the decision to a model: it wraps the
+  trailing repeated blocks (found via :func:`find_repeated_blocks`) in
+  ``fleet.utils.recompute`` (→ ``jax.checkpoint``), re-traces the step,
+  and grows the wrapped count until the re-traced timeline fits. The
+  final prediction therefore comes from the REAL post-remat jaxpr — the
+  same upper-bound-never-under contract ``crosscheck_mem`` enforces —
+  never from the planner's estimate alone.
+
+Wire-up: ``hapi.Model.prepare(remat="auto" | budget_bytes)`` and
+``distributed.auto_parallel.Engine(remat=...)`` call :func:`auto_remat`
+lazily against the first real batch (the same one-shot hook the graph
+autolint uses), so the remat decision sees the true shapes.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "RematSite",
+    "RematPlan",
+    "candidate_sites",
+    "plan_remat",
+    "find_repeated_blocks",
+    "wrap_block",
+    "unwrap_block",
+    "clear_remat",
+    "resolve_budget",
+    "auto_remat",
+    "AutoRematReport",
+]
+
+
+def _fmt_mib(n):
+    return f"{float(n) / 2**20:.1f} MiB"
+
+
+class RematSite:
+    """One checkpointing site: the long-lived buffers born at a shared
+    source location (all N layer instances of one block line)."""
+
+    __slots__ = ("where", "keys", "nbytes", "n_buffers", "tag", "delta")
+
+    def __init__(self, where, buffers):
+        self.where = where
+        self.keys = [b.key for b in buffers]
+        self.nbytes = float(sum(b.nbytes for b in buffers))
+        self.n_buffers = len(buffers)
+        self.tag = buffers[0].tag if buffers else ""
+        self.delta = 0.0  # marginal predicted-peak drop (set by plan_remat)
+
+    def as_dict(self):
+        return {"where": self.where, "n_buffers": self.n_buffers,
+                "nbytes": self.nbytes, "tag": self.tag, "delta": self.delta}
+
+    def __repr__(self):
+        return (f"RematSite({self.where!r}, {self.n_buffers} bufs, "
+                f"{_fmt_mib(self.nbytes)}, delta={_fmt_mib(self.delta)})")
+
+
+def candidate_sites(timeline, min_bytes=None, min_span=None):
+    """Group the timeline's remat candidates (``long_lived``) by ``where``
+    provenance — one site per producing source line, largest first."""
+    from .mem_lint import MEM_LINT_DEFAULTS
+
+    mb = min_bytes if min_bytes is not None else \
+        MEM_LINT_DEFAULTS["remat_min_bytes"]
+    ms = min_span if min_span is not None else \
+        MEM_LINT_DEFAULTS["remat_min_span"]
+    groups = {}
+    for b in timeline.long_lived(mb, ms):
+        groups.setdefault(b.where or f"eqn {b.birth}", []).append(b)
+    sites = [RematSite(w, bs) for w, bs in groups.items()]
+    sites.sort(key=lambda s: -s.nbytes)
+    return sites
+
+
+class RematPlan:
+    """The planner's decision: which sites to checkpoint and the predicted
+    peak before/after. ``ok`` means the PREDICTED peak fits the budget —
+    :func:`auto_remat` re-verifies against the applied program."""
+
+    def __init__(self, timeline, budget_bytes, sites, considered):
+        self.budget_bytes = budget_bytes
+        self.sites = list(sites)
+        self.considered = list(considered)
+        self.peak_before = float(timeline.peak_bytes)
+        keys = [k for s in self.sites for k in s.keys]
+        self.peak_after = self.peak_before - (
+            float(timeline.delta_if_remat(keys)) if keys else 0.0)
+        self.ok = budget_bytes is None or self.peak_after <= budget_bytes
+
+    @property
+    def delta(self):
+        return self.peak_before - self.peak_after
+
+    def as_dict(self):
+        return {"budget_bytes": self.budget_bytes, "ok": self.ok,
+                "peak_before": self.peak_before,
+                "peak_after": self.peak_after,
+                "sites": [s.as_dict() for s in self.sites],
+                "considered": [s.as_dict() for s in self.considered]}
+
+    def table(self):
+        b = ("no budget" if self.budget_bytes is None
+             else _fmt_mib(self.budget_bytes))
+        lines = [f"remat plan — predicted peak {_fmt_mib(self.peak_before)}"
+                 f" -> {_fmt_mib(self.peak_after)} (budget {b},"
+                 f" {'fits' if self.ok else 'DOES NOT FIT'})"]
+        for s in self.sites:
+            lines.append(f"  checkpoint {s.where or '<?>'}: "
+                         f"{s.n_buffers} buffers {_fmt_mib(s.nbytes)}"
+                         f"{' [' + s.tag + ']' if s.tag else ''} "
+                         f"-> peak -{_fmt_mib(s.delta)}")
+        if not self.sites:
+            lines.append("  (no sites chosen)")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (f"RematPlan(sites={len(self.sites)}, "
+                f"peak={_fmt_mib(self.peak_before)}->"
+                f"{_fmt_mib(self.peak_after)}, ok={self.ok})")
+
+
+def plan_remat(timeline, budget_bytes=None, max_sites=None, min_bytes=None,
+               min_span=None):
+    """Greedy site selection: repeatedly add the site with the best
+    marginal peak reduction per recomputed byte until the predicted peak
+    fits ``budget_bytes`` (or, with no budget, until no site still helps).
+
+    The marginal delta is exact per evaluation —
+    :meth:`~.mem_lint.MemoryTimeline.delta_if_remat` re-sweeps the whole
+    event timeline for the chosen union, so overlapping lifetimes never
+    double-count."""
+    considered = candidate_sites(timeline, min_bytes, min_span)
+    budget = None if budget_bytes is None else float(budget_bytes)
+    chosen, chosen_keys = [], []
+    cur_delta = 0.0
+    remaining = list(considered)
+    while remaining:
+        if budget is not None and \
+                timeline.peak_bytes - cur_delta <= budget:
+            break
+        if max_sites is not None and len(chosen) >= max_sites:
+            break
+        best, best_delta, best_score = None, 0.0, 0.0
+        for s in remaining:
+            d = float(timeline.delta_if_remat(chosen_keys + s.keys))
+            marginal = d - cur_delta
+            score = marginal / max(s.nbytes, 1.0)
+            if marginal > 0 and score > best_score:
+                best, best_delta, best_score = s, d, score
+        if best is None:
+            break  # nothing left moves the peak
+        best.delta = best_delta - cur_delta
+        cur_delta = best_delta
+        chosen.append(best)
+        chosen_keys.extend(best.keys)
+        remaining.remove(best)
+    return RematPlan(timeline, budget, chosen, considered)
+
+
+# ---------------------------------------------------------------------------
+# application: wrap repeated blocks in fleet recompute (jax.checkpoint)
+# ---------------------------------------------------------------------------
+
+def find_repeated_blocks(network):
+    """The longest LayerList of >= 2 same-type sublayers — the repeated
+    transformer blocks (``GPTModel.layers``, BERT's encoder stack). These
+    are the natural ``jax.checkpoint`` boundaries: each block's residuals
+    trade for one block of recompute."""
+    from ..nn.layer.container import LayerList
+
+    best = None
+    for layer in network.sublayers(include_self=True):
+        if not isinstance(layer, LayerList) or len(layer) < 2:
+            continue
+        if len({type(l) for l in layer}) != 1:
+            continue
+        if best is None or len(layer) > len(best):
+            best = layer
+    return list(best) if best is not None else []
+
+
+def wrap_block(layer):
+    """Route this block's training forward through fleet recompute
+    (``jax.checkpoint``). Gated: serving calls (``cache=`` present) and
+    eval-mode forwards run the original path — there is no backward to
+    save bytes for. Idempotent; undo with :func:`unwrap_block`."""
+    if getattr(layer, "_remat_wrapped", False):
+        return layer
+    orig = layer.forward
+
+    def fwd(*args, **kwargs):
+        if not layer.training or kwargs.get("cache") is not None:
+            return orig(*args, **kwargs)
+        from ..distributed.fleet.utils.recompute import recompute
+
+        return recompute(orig, *args, params=list(layer.parameters()),
+                         **kwargs)
+
+    object.__setattr__(layer, "_remat_orig_forward", orig)
+    object.__setattr__(layer, "forward", fwd)
+    object.__setattr__(layer, "_remat_wrapped", True)
+    return layer
+
+
+def unwrap_block(layer):
+    if getattr(layer, "_remat_wrapped", False):
+        object.__setattr__(layer, "forward", layer._remat_orig_forward)
+        object.__setattr__(layer, "_remat_wrapped", False)
+    return layer
+
+
+def clear_remat(network):
+    """Restore every block :func:`auto_remat` wrapped on ``network``."""
+    n = 0
+    for layer in network.sublayers(include_self=True):
+        if getattr(layer, "_remat_wrapped", False):
+            unwrap_block(layer)
+            n += 1
+    return n
+
+
+def resolve_budget(remat):
+    """Normalize the user knob: ``"auto"`` → the runtime's per-device HBM
+    capacity (None when the backend doesn't report one — plain XLA:CPU);
+    a number → bytes; True behaves like ``"auto"``."""
+    if remat in ("auto", True):
+        from .mem_lint import device_capacity_bytes
+
+        return device_capacity_bytes()
+    if remat in (None, False):
+        return None
+    return float(remat)
+
+
+class AutoRematReport:
+    """What :func:`auto_remat` did: the planner's estimate plus the
+    re-traced (applied) truth."""
+
+    __slots__ = ("budget_bytes", "peak_before", "peak_after",
+                 "blocks_wrapped", "blocks_total", "ok", "plan", "timeline")
+
+    def as_dict(self):
+        return {"budget_bytes": self.budget_bytes,
+                "peak_before": self.peak_before,
+                "peak_after": self.peak_after,
+                "blocks_wrapped": self.blocks_wrapped,
+                "blocks_total": self.blocks_total, "ok": self.ok,
+                "plan": self.plan.as_dict() if self.plan else None}
+
+    def table(self):
+        b = ("no budget" if self.budget_bytes is None
+             else _fmt_mib(self.budget_bytes))
+        lines = [f"auto-remat — wrapped {self.blocks_wrapped}/"
+                 f"{self.blocks_total} blocks; predicted peak "
+                 f"{_fmt_mib(self.peak_before)} -> "
+                 f"{_fmt_mib(self.peak_after)} (budget {b}, "
+                 f"{'fits' if self.ok else 'DOES NOT FIT'})"]
+        if self.plan is not None and self.plan.sites:
+            lines.append(self.plan.table())
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return (f"AutoRematReport(wrapped={self.blocks_wrapped}/"
+                f"{self.blocks_total}, peak={_fmt_mib(self.peak_before)}->"
+                f"{_fmt_mib(self.peak_after)}, ok={self.ok})")
+
+
+def auto_remat(network, budget, make_step, example_args, name="train_step"):
+    """Apply selective remat to ``network`` until the step's predicted
+    peak fits ``budget`` bytes.
+
+    ``make_step()`` must return a FRESH steppable (CompiledStep or plain
+    callable) reflecting the network's current wrapping each time it is
+    called — the caller drops its cached step first. ``example_args`` is
+    the real first batch (shape-faithful); all tracing is abstract, no
+    device execution, no compile.
+
+    Strategy: plan on the baseline timeline for the initial block count,
+    then wrap the LEADING repeated blocks (their residuals live longest —
+    born first, consumed last in the backward) and re-trace; grow the
+    wrapped count until the RE-TRACED peak fits or every block is
+    wrapped. The returned report's ``peak_after`` always comes from the
+    applied program's own timeline, so the ``crosscheck_mem`` upper-bound
+    contract applies to it unchanged."""
+    from .mem_lint import analyze_memory
+
+    budget = resolve_budget(budget)
+    rep = AutoRematReport()
+    rep.budget_bytes = budget
+
+    tl0 = analyze_memory(make_step(), *example_args)
+    tl0.name = tl0.name or name
+    rep.peak_before = float(tl0.peak_bytes)
+    rep.plan = plan_remat(tl0, budget)
+    blocks = find_repeated_blocks(network)
+    rep.blocks_total = len(blocks)
+
+    if budget is not None and rep.peak_before <= budget:
+        rep.peak_after = rep.peak_before
+        rep.blocks_wrapped = 0
+        rep.ok = True
+        rep.timeline = tl0
+        return rep
+    if not blocks or (budget is None and not rep.plan.sites):
+        # nothing to wrap (no repeated stack) or nothing predicted to help
+        rep.peak_after = rep.peak_before
+        rep.blocks_wrapped = 0
+        rep.ok = budget is None
+        rep.timeline = tl0
+        return rep
+
+    # initial guess from the plan (>=1); each round doubles until fit
+    k = max(1, min(len(blocks), len(rep.plan.sites) or 1))
+    tl = tl0
+    while True:
+        for blk in blocks[:k]:
+            wrap_block(blk)
+        tl = analyze_memory(make_step(), *example_args)
+        if budget is None or tl.peak_bytes <= budget or k >= len(blocks):
+            break
+        k = min(len(blocks), max(k + 1, 2 * k))
+    rep.peak_after = float(tl.peak_bytes)
+    rep.blocks_wrapped = k
+    rep.ok = budget is None or rep.peak_after <= budget
+    rep.timeline = tl
+    return rep

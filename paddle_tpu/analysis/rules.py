@@ -734,20 +734,37 @@ def _hbm_remat_candidate(graph):
         what = ("scan residuals saved for the backward"
                 if b.tag in ("residual", "scan-ys")
                 else "an activation held for the backward")
+        # quantify the win from the liveness timeline (mirror of the
+        # donation rule's delta_if_donated): predicted peak delta if THIS
+        # buffer were rematerialized — the same number the auto-remat
+        # planner (analysis.remat_plan) ranks sites by
+        try:
+            freed = float(tl.delta_if_remat([b.key]))
+        except Exception:
+            freed = 0.0
+        hint = ("wrap the producing block in jax.checkpoint (a.k.a. "
+                "jax.remat): forward recomputes it in the backward "
+                "instead of holding it — or let the planner pick the "
+                'sites: `Model.prepare(remat="auto")` / '
+                "`Engine(remat=budget_bytes)` "
+                "(analysis.remat_plan.plan_remat)")
+        data = {"nbytes": b.nbytes, "span": span, "tag": b.tag,
+                "birth": b.birth, "death": b.death,
+                "peak_fraction": b.nbytes / tl.peak_bytes}
+        msg = (f"{b.dtype}{list(b.shape)} ({_fmt_mib(b.nbytes)}, "
+               f"{100.0 * b.nbytes / tl.peak_bytes:.0f}% of peak) "
+               f"lives across {span:.0%} of the step — {what}")
+        if freed > 0:
+            data["delta_if_remat"] = freed
+            msg += (f"; rematerializing it is predicted to cut the peak "
+                    f"by {_fmt_mib(freed)}")
         yield Finding(
             rule="hbm-remat-candidate",
             severity="warning",
-            message=f"{b.dtype}{list(b.shape)} ({_fmt_mib(b.nbytes)}, "
-                    f"{100.0 * b.nbytes / tl.peak_bytes:.0f}% of peak) "
-                    f"lives across {span:.0%} of the step — {what}",
+            message=msg,
             where=b.where,
-            hint="wrap the producing block in jax.checkpoint (a.k.a. "
-                 "jax.remat): forward recomputes it in the backward "
-                 "instead of holding it, e.g. "
-                 "`block = jax.checkpoint(block)` at the layer boundary",
-            data={"nbytes": b.nbytes, "span": span, "tag": b.tag,
-                  "birth": b.birth, "death": b.death,
-                  "peak_fraction": b.nbytes / tl.peak_bytes},
+            hint=hint,
+            data=data,
         )
 
 

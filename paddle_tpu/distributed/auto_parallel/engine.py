@@ -28,8 +28,16 @@ def _to_list(x):
 class Engine:
     def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
                  cluster=None, strategy=None, process_mesh=None,
-                 graph_lint=None, zero_stage=0, zero_configs=None):
+                 graph_lint=None, zero_stage=0, zero_configs=None,
+                 remat=None):
         self.model = model
+        # remat: selective-remat autopilot (analysis.remat_plan.auto_remat)
+        # applied lazily against the first fit batch — "auto" budgets the
+        # device's reported HBM capacity, a number is explicit bytes. The
+        # report lands on self.remat_report_.
+        self._remat = remat
+        self._remat_applied = False
+        self.remat_report_ = None
         # zero_stage: ZeRO sharding of the weight update over the mesh's
         # data dim. 1/2 -> sharding.ShardedOptimizer (reduce-scatter grads,
         # update the local 1/dp shard, all-gather params — under GSPMD the
@@ -519,6 +527,21 @@ class Engine:
                         break
                     if self._auto_plan_pending:
                         self._auto_plan(first[0], first[1])
+                    if self._remat and not self._remat_applied:
+                        # one-shot auto-remat BEFORE the step compiles: the
+                        # wrap decision re-traces abstractly, then the
+                        # final wrapping compiles exactly once
+                        self._remat_applied = True
+                        from ... import analysis
+
+                        def _fresh_step():
+                            self._train_step = None
+                            return self._ensure_train()
+
+                        self.remat_report_ = analysis.auto_remat(
+                            self.model, self._remat, _fresh_step,
+                            (first[0], first[1]), name="auto_parallel_train")
+                        self._train_step = None
                     step = self._ensure_train()
                     if not self._graph_linted:
                         self._graph_linted = True

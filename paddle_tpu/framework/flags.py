@@ -129,3 +129,17 @@ register_flag("flash_attention_min_seq_prod", 1024 * 1024,
               help="route sdpa to XLA einsum below this sq*sk; at 1024^2 and "
                    "above the Pallas kernel with 1024-blocks measures faster "
                    "than the einsum path on v5e")
+register_flag("disable_blockwise_attention", False,
+              help="route length-masked/long-causal sdpa to the dense "
+                   "einsum path (debugging / parity bisection)")
+register_flag("blockwise_attention_min_kv", 1024,
+              help="KV length at/above which sdpa takes the blockwise "
+                   "online-softmax scan (cached serving paths and causal "
+                   "training without Pallas); below it the fused einsum "
+                   "wins and its score matrix is small anyway")
+register_flag("blockwise_attention_block_q", 512,
+              help="query block for the blockwise-attention backward scan "
+                   "(largest divisor of seq_q <= this is used)")
+register_flag("blockwise_attention_block_k", 512,
+              help="KV block for the blockwise-attention scan (largest "
+                   "divisor of seq_k <= this is used)")

@@ -65,12 +65,15 @@ class Model:
         self._pred_step = None
         self._graph_lint = None
         self._graph_linted = False
+        self._remat = None
+        self._remat_applied = False
+        self._remat_report = None
 
     # ------------------------------------------------------------------
     # setup
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None,
-                graph_lint=None, zero=None):
+                graph_lint=None, zero=None, remat=None):
         """Reference ``model.py:1499``.
 
         ``graph_lint=True`` statically lints the compiled train step against
@@ -82,7 +85,17 @@ class Model:
         (``distributed.sharding.ShardedOptimizer``): ``zero="dp"`` names
         the axis, ``zero=True`` uses the default mesh's first axis, and a
         dict forwards configs, e.g. ``{"axis": "dp", "quantize": "int8"}``
-        for the int8 error-feedback param all-gather."""
+        for the int8 error-feedback param all-gather.
+
+        ``remat`` arms the selective-remat autopilot
+        (``analysis.remat_plan.auto_remat``), applied lazily against the
+        first real train batch: ``remat="auto"`` budgets the device's
+        reported HBM capacity, a number is an explicit byte budget. The
+        planner checkpoints just enough of the repeated decoder blocks
+        (``jax.checkpoint`` via fleet recompute) to bring the PREDICTED
+        peak (``analysis.analyze_memory``, re-traced after application)
+        under the budget; the report lands on
+        ``model._remat_report``."""
         if zero and optimizer is not None:
             from ..distributed.mesh import get_mesh
             from ..distributed.sharding import ShardedOptimizer
@@ -110,6 +123,9 @@ class Model:
         self._pred_step = None
         self._graph_lint = graph_lint
         self._graph_linted = False
+        self._remat = remat
+        self._remat_applied = False
+        self._remat_report = None
 
     def _compute_loss(self, outputs, labels):
         outs = _to_list(outputs)
@@ -207,6 +223,21 @@ class Model:
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer, loss, ...) before training")
         ins, labs = self._split_batch(inputs, labels)
+        if self._remat and not self._remat_applied:
+            # one-shot selective-remat autopilot against the first real
+            # batch (same lazy hook as the graph autolint below); tracing
+            # is abstract, the step compiles once AFTER the wrap decision
+            self._remat_applied = True
+            from ..analysis import remat_plan as _rp
+
+            def _fresh_step():
+                self._train_step = None
+                return self._ensure_train_step()
+
+            self._remat_report = _rp.auto_remat(
+                self.network, self._remat, _fresh_step,
+                tuple(ins + labs), name="train_step")
+            self._train_step = None  # rebuild against the final wrapping
         step = self._ensure_train_step()
         if not self._graph_linted:
             # one-shot static lint against the first real batch (opt-in via

@@ -88,7 +88,11 @@ from .loss import (  # noqa: F401
     square_error_cost,
     triplet_margin_loss,
 )
-from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    LengthMask,
+    flash_attention,
+    scaled_dot_product_attention,
+)
 from .sparse_attention import sparse_attention  # noqa: F401
 from ...ops.fused import fused_linear_cross_entropy  # noqa: F401
 from .vision import affine_grid, grid_sample  # noqa: F401

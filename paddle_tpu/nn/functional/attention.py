@@ -5,19 +5,276 @@ and ``fmha_ref.h``. TPU-native path: the Pallas flash-attention kernel
 (``paddle_tpu.ops.pallas.flash_attention``) whenever shapes tile onto the MXU
 and no attention dropout is requested; an XLA einsum path otherwise.
 
-Routing is an EXPLICIT capability check (``_flash_ok``), never a silent
-``except`` fallback: if the Pallas kernel is selected and fails, the error
-propagates.
+Long context adds a third path: a blockwise online-softmax ``lax.scan`` over
+KV blocks (``_sdpa_blockwise``) that keeps the live logits at
+O(seq·block) instead of O(seq²) on every backend, selected for causal
+training above ``blockwise_attention_min_kv`` keys and for every cached
+(:class:`LengthMask`) serving call — prefill, chunked prefill, decode and
+speculative verify never materialize ``[b, h, q, max_len]`` scores.
+
+Routing is an EXPLICIT capability check (``_flash_ok`` /
+``_blockwise_ok``), never a silent ``except`` fallback: if a kernel is
+selected and fails, the error propagates.
 """
 from __future__ import annotations
 
 import math
+from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework import random as rnd
 from ...ops.dispatch import op
+
+#: additive-mask floor shared with serving.kv_cache.MASK_MIN
+NEG_INF = -1e30
+
+
+class LengthMask:
+    """Compact validity descriptor for cached (length-masked) attention.
+
+    Key slot ``j`` attends to query row ``i`` of batch ``b`` iff
+    ``j <= q_pos[b, i]`` and, when ``kv_len`` is given, ``j < kv_len[b]``.
+    ``q_pos`` is int32 ``[batch, q]`` (absolute position of each query row in
+    the cache); ``kv_len`` is int32 ``[batch]`` (exclusive bound of rows ever
+    written). The serving engine hands this to
+    ``scaled_dot_product_attention`` instead of a dense ``[b, 1, q, max_len]``
+    additive mask: the blockwise/Pallas paths consume the lengths directly and
+    the einsum fallback expands the mask on the fly in the compute dtype.
+    """
+
+    __slots__ = ("q_pos", "kv_len")
+
+    def __init__(self, q_pos, kv_len=None):
+        self.q_pos = jnp.asarray(q_pos, jnp.int32)
+        self.kv_len = None if kv_len is None else jnp.asarray(kv_len,
+                                                              jnp.int32)
+
+    def valid(self, sk):
+        """Boolean ``[b, 1, q, sk]`` validity (broadcasts over heads)."""
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, sk), 3)
+        ok = col <= self.q_pos[:, None, :, None]
+        if self.kv_len is not None:
+            ok = ok & (col < self.kv_len[:, None, None, None])
+        return ok
+
+    def additive(self, sk, dtype, mask_min=-1e9):
+        """Dense additive mask materialized on the fly in ``dtype`` — the
+        short-sequence fallback; never an fp32 constant the compiler could
+        fold and hold in HBM."""
+        return jnp.where(self.valid(sk), jnp.asarray(0.0, dtype),
+                         jnp.asarray(mask_min, dtype))
+
+
+def _pick_block(n, pref):
+    """Largest divisor of ``n`` that is <= ``pref`` (no padding: padding a
+    KV cache block would copy the cache)."""
+    for c in range(min(int(pref), n), 0, -1):
+        if n % c == 0:
+            return c
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# blockwise online-softmax scan (runs on every backend, incl. XLA:CPU)
+# ---------------------------------------------------------------------------
+
+def _bw_fwd(q, k, v, q_pos, kv_len, scale, block_k):
+    """Forward scan over KV blocks. Carry: running (max, denom, acc) per
+    query row; the only O(block)-wide temporary is the ``[b, h, sq,
+    block_k]`` score tile of the current block."""
+    f32 = jnp.float32
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    nb = sk // block_k
+    qf = jnp.swapaxes(q, 1, 2).astype(f32) * scale            # [b,h,sq,d]
+    ks = jnp.moveaxis(
+        jnp.swapaxes(k, 1, 2).astype(f32).reshape(b, h, nb, block_k, d), 2, 0)
+    vs = jnp.moveaxis(
+        jnp.swapaxes(v, 1, 2).astype(f32).reshape(b, h, nb, block_k, d), 2, 0)
+    base = jnp.arange(nb, dtype=jnp.int32) * block_k
+    qpos_e = q_pos[:, None, :, None]
+    klen_e = None if kv_len is None else kv_len[:, None, None, None]
+
+    def body(carry, xs):
+        m, l, acc = carry
+        kb, vb, b0 = xs
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", qf, kb)
+        col = b0 + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, block_k), 3)
+        ok = col <= qpos_e
+        if klen_e is not None:
+            ok = ok & (col < klen_e)
+        s_ = jnp.where(ok, s_, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s_, axis=-1))
+        # masked entries must contribute 0 even when the whole row is masked
+        # so far (m_new == NEG_INF would make exp(s - m_new) = 1)
+        p = jnp.where(ok, jnp.exp(s_ - m_new[..., None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((b, h, sq), NEG_INF, f32)
+    l0 = jnp.zeros((b, h, sq), f32)
+    a0 = jnp.zeros((b, h, sq, d), f32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), (ks, vs, base))
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype), lse
+
+
+def _bw_bwd(q, k, v, q_pos, kv_len, out, lse, g, scale, block_q, block_k):
+    """FlashAttention-2 recurrence: dq scans K blocks, dk/dv scan Q blocks;
+    every score tile is recomputed from the saved logsumexp so nothing
+    O(sq·sk) is ever live."""
+    f32 = jnp.float32
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = jnp.swapaxes(q, 1, 2).astype(f32)
+    kf = jnp.swapaxes(k, 1, 2).astype(f32)
+    vf = jnp.swapaxes(v, 1, 2).astype(f32)
+    gf = jnp.swapaxes(g, 1, 2).astype(f32)
+    of = jnp.swapaxes(out, 1, 2).astype(f32)
+    delta = jnp.sum(of * gf, axis=-1)                         # [b,h,sq]
+    qpos_e = q_pos[:, None, :, None]
+    klen_e = None if kv_len is None else kv_len[:, None, None, None]
+
+    nbk = sk // block_k
+    ks = jnp.moveaxis(kf.reshape(b, h, nbk, block_k, d), 2, 0)
+    vs = jnp.moveaxis(vf.reshape(b, h, nbk, block_k, d), 2, 0)
+    basek = jnp.arange(nbk, dtype=jnp.int32) * block_k
+
+    def dq_body(dq, xs):
+        kb, vb, b0 = xs
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * scale
+        col = b0 + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, block_k), 3)
+        ok = col <= qpos_e
+        if klen_e is not None:
+            ok = ok & (col < klen_e)
+        p = jnp.where(ok, jnp.exp(s_ - lse[..., None]), 0.0)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vb)
+        ds = p * (dp - delta[..., None])
+        return dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb) * scale, None
+
+    dq, _ = jax.lax.scan(dq_body, jnp.zeros((b, h, sq, d), f32),
+                         (ks, vs, basek))
+
+    nbq = sq // block_q
+    qs = jnp.moveaxis(qf.reshape(b, h, nbq, block_q, d), 2, 0)
+    gs = jnp.moveaxis(gf.reshape(b, h, nbq, block_q, d), 2, 0)
+    ls = jnp.moveaxis(lse.reshape(b, h, nbq, block_q), 2, 0)
+    dls = jnp.moveaxis(delta.reshape(b, h, nbq, block_q), 2, 0)
+    pqs = jnp.moveaxis(q_pos.reshape(b, nbq, block_q), 1, 0)
+    colk = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, sk), 3)
+
+    def dkv_body(carry, xs):
+        dk, dv = carry
+        qb, gb, lb, db, pq = xs
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", qb, kf) * scale
+        ok = colk <= pq[:, None, :, None]
+        if klen_e is not None:
+            ok = ok & (colk < klen_e)
+        p = jnp.where(ok, jnp.exp(s_ - lb[..., None]), 0.0)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, gb)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gb, vf)
+        ds = p * (dp - db[..., None])
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, qb) * scale
+        return (dk, dv), None
+
+    z = jnp.zeros((b, h, sk, d), f32)
+    (dk, dv), _ = jax.lax.scan(dkv_body, (z, z), (qs, gs, ls, dls, pqs))
+
+    def back(x, dt):
+        return jnp.swapaxes(x, 1, 2).astype(dt)
+
+    return back(dq, q.dtype), back(dk, k.dtype), back(dv, v.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _blockwise(q, k, v, q_pos, kv_len, scale, block_q, block_k):
+    out, _ = _bw_fwd(q, k, v, q_pos, kv_len, scale, block_k)
+    return out
+
+
+def _blockwise_vjp_fwd(q, k, v, q_pos, kv_len, scale, block_q, block_k):
+    # the custom vjp is mandatory, not an optimization: naive AD of the scan
+    # would stack the per-block probability tiles into an O(sq·sk) residual
+    out, lse = _bw_fwd(q, k, v, q_pos, kv_len, scale, block_k)
+    return out, (q, k, v, q_pos, kv_len, out, lse)
+
+
+def _blockwise_vjp_bwd(scale, block_q, block_k, res, g):
+    q, k, v, q_pos, kv_len, out, lse = res
+    dq, dk, dv = _bw_bwd(q, k, v, q_pos, kv_len, out, lse, g, scale,
+                         block_q, block_k)
+    zp = np.zeros(q_pos.shape, dtype=jax.dtypes.float0)
+    zl = (None if kv_len is None
+          else np.zeros(kv_len.shape, dtype=jax.dtypes.float0))
+    return dq, dk, dv, zp, zl
+
+
+_blockwise.defvjp(_blockwise_vjp_fwd, _blockwise_vjp_bwd)
+
+
+@op("blockwise_sdpa")
+def _sdpa_blockwise(q, k, v, q_pos, kv_len=None, scale=None, block_q=0,
+                    block_k=0):
+    """Blockwise online-softmax attention (q,k,v in paddle (b,s,h,d)
+    layout). ``q_pos``/``kv_len`` follow :class:`LengthMask` semantics."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    return _blockwise(q, k, v, q_pos, kv_len, s, block_q, block_k)
+
+
+def _blockwise_ok(q_shape, k_shape, dropout_p, training):
+    """Blockwise path: no attention dropout (the scan has no in-kernel PRNG)
+    and at least ``blockwise_attention_min_kv`` key slots — below that the
+    fused einsum is faster and its score matrix is small anyway."""
+    from ...framework.flags import flag_value
+
+    if flag_value("disable_blockwise_attention"):
+        return False
+    if dropout_p > 0.0 and training:
+        return False
+    return k_shape[1] >= flag_value("blockwise_attention_min_kv")
+
+
+def _blockwise_blocks(sq, sk):
+    from ...framework.flags import flag_value
+
+    bq = _pick_block(sq, flag_value("blockwise_attention_block_q") or 512)
+    bk = _pick_block(sk, flag_value("blockwise_attention_block_k") or 512)
+    return bq, bk
+
+
+def _route_length_masked(query, key, value, lm, dropout_p, training, scale):
+    """Cached-attention routing: Pallas length-masked kernel when the shapes
+    tile onto the MXU, blockwise scan otherwise, dense on-the-fly mask below
+    the min-kv threshold (or under attention dropout)."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    active_p = dropout_p if training else 0.0
+    if _blockwise_ok(query.shape, key.shape, dropout_p, training):
+        from ...ops import pallas
+
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        if pallas.is_available():
+            from ...ops.pallas.flash_attention import supports_cached
+
+            if supports_cached(sq, sk, d):
+                return _sdpa_flash_cached(query, key, value, lm.q_pos,
+                                          lm.kv_len, scale=s)
+        bq, bk = _blockwise_blocks(sq, sk)
+        return _sdpa_blockwise(query, key, value, lm.q_pos, lm.kv_len,
+                               scale=s, block_q=bq, block_k=bk)
+    mask = lm.additive(sk, query.dtype)
+    dropout_mask = None
+    if active_p > 0.0:
+        dropout_mask = jax.random.bernoulli(
+            rnd.next_key(), 1.0 - active_p, (b, h, sq, sk))
+    return _sdpa_raw(query, key, value, mask, dropout_mask, causal=False,
+                     scale=scale, dropout_p=active_p)
 
 
 def _flash_ok(q_shape, k_shape, mask, dropout_p, training, mask_trainable=False):
@@ -87,6 +344,16 @@ def _sdpa_flash(q, k, v, mask=None, dropout_seed=None, causal=False,
               dropout_p=dropout_p, dropout_seed=dropout_seed)
 
 
+@op("flash_sdpa_cached")
+def _sdpa_flash_cached(q, k, v, q_pos, kv_len=None, scale=None):
+    """Pallas length-masked (cached-attention) kernel — inference path; the
+    per-tile validity comes from the streamed positions, never a dense
+    bias."""
+    from ...ops.pallas.flash_attention import flash_attention_cached
+
+    return flash_attention_cached(q, k, v, q_pos, kv_len, scale=scale)
+
+
 @op("sdpa")
 def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
               dropout_p=0.0):
@@ -99,9 +366,14 @@ def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
     if causal:
+        # iota compare, not jnp.tril of a ones constant: the latter const-
+        # folds into an fp32 [s, s] executable constant charged against HBM
+        # (O(seq²) bytes at 32k — the hbm-const-folded finding)
         ql, kl = logits.shape[-2], logits.shape[-1]
-        cmask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        logits = jnp.where(cmask, logits, -1e30)
+        row = jax.lax.broadcasted_iota(jnp.int32, (ql, kl), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (ql, kl), 1)
+        logits = jnp.where(col - row <= kl - ql, logits,
+                           jnp.asarray(NEG_INF, logits.dtype))
     if mask is not None:
         if mask.dtype == jnp.bool_:
             logits = jnp.where(mask, logits, -1e30)
@@ -115,6 +387,9 @@ def _sdpa_raw(q, k, v, mask=None, dropout_mask=None, causal=False, scale=None,
 
 def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
           training=True, scale=None):
+    if isinstance(attn_mask, LengthMask):
+        return _route_length_masked(query, key, value, attn_mask, dropout_p,
+                                    training, scale)
     trainable = (attn_mask is not None
                  and getattr(attn_mask, "stop_gradient", True) is False)
     if _flash_ok(query.shape, key.shape, attn_mask, dropout_p, training,
@@ -129,6 +404,18 @@ def _sdpa(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False,
         return _sdpa_flash(query, key, value, attn_mask, seed,
                            causal=is_causal, scale=scale,
                            mask_trainable=trainable, dropout_p=active_p)
+    if (attn_mask is None and is_causal
+            and _blockwise_ok(query.shape, key.shape, dropout_p, training)):
+        # long causal training without Pallas (e.g. XLA:CPU): blockwise scan
+        # instead of the O(seq²) einsum score matrix
+        b, sq, _, d = query.shape
+        sk = key.shape[1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        q_pos = jnp.broadcast_to(
+            jnp.arange(sk - sq, sk, dtype=jnp.int32)[None, :], (b, sq))
+        bq, bk = _blockwise_blocks(sq, sk)
+        return _sdpa_blockwise(query, key, value, q_pos, None, scale=s,
+                               block_q=bq, block_k=bk)
     dropout_mask = None
     if dropout_p > 0.0 and training:
         b, sq, h, _ = query.shape

@@ -50,11 +50,12 @@ def is_available() -> bool:
         return False
 
 
-from .flash_attention import flash_attention  # noqa: E402
+from .flash_attention import flash_attention, flash_attention_cached  # noqa: E402,E501
 from .layer_norm import fused_layer_norm  # noqa: E402
 
 __all__ = [
     "flash_attention",
+    "flash_attention_cached",
     "fused_layer_norm",
     "is_available",
     "interpret_mode",

@@ -540,6 +540,170 @@ def supports(seq_q, seq_k, head_dim=None,
     return _pick_block(seq_q, block_q) > 0 and _pick_block(seq_k, block_k) > 0
 
 
+# ---------------------------------------------------------------------------
+# length-masked (cached) forward — serving prefill / chunked prefill / verify
+# ---------------------------------------------------------------------------
+
+def _cached_fwd_kernel(q_ref, k_ref, v_ref, qpos_ref, klen_ref, o_ref,
+                       acc_ref, m_ref, l_ref, *, scale, block_k):
+    """Online-softmax sweep with per-row validity from streamed positions:
+    key slot j attends iff ``j <= q_pos[row]`` and ``j < kv_len[batch]`` —
+    the LengthMask contract — so no dense bias ever reaches HBM."""
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s = jax.lax.dot_general(
+        q_ref[0, 0], k_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    qpos = qpos_ref[0, 0][:, 0:1]
+    valid = (cols <= qpos) & (cols < klen_ref[0, 0])
+    s = jnp.where(valid, s, NEG_INF)
+    m_prev = m_ref[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = _zero_masked_rows(jnp.exp(s - m_new), m_new)
+    l_new = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+
+
+def _flash_cached_impl(q, k, v, qpos, klen, scale, block_q, block_k,
+                       interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    nq, nk = sq // block_q, sk // block_k
+
+    def qmap(bb, hh, qi, ki):
+        return (bb, hh, qi, 0)
+
+    def kmap(bb, hh, qi, ki):
+        return (bb, hh, ki, 0)
+
+    kernel = functools.partial(_cached_fwd_kernel, scale=scale,
+                               block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), qmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, 1, block_k, d), kmap),
+            pl.BlockSpec((1, 1, block_q, STAT_LANES),
+                         lambda bb, hh, qi, ki: (bb, 0, qi, 0)),
+            pl.BlockSpec((1, 1), lambda bb, hh, qi, ki: (bb, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * b * h * sq * sk * d),
+            bytes_accessed=int(2 * (q.size + k.size + v.size + q.size)),
+            transcendentals=int(b * h * sq * sk),
+        ),
+    )(q, k, v, qpos, klen)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_cached(q, k, v, qpos, klen, scale, block_q, block_k, interpret):
+    return _flash_cached_impl(q, k, v, qpos, klen, scale, block_q, block_k,
+                              interpret)
+
+
+def _flash_cached_vjp_fwd(q, k, v, qpos, klen, scale, block_q, block_k,
+                          interpret):
+    out = _flash_cached_impl(q, k, v, qpos, klen, scale, block_q, block_k,
+                             interpret)
+    return out, ()
+
+
+def _flash_cached_vjp_bwd(scale, block_q, block_k, interpret, res, g):
+    raise NotImplementedError(
+        "flash_attention_cached is inference-only (serving holds no "
+        "gradients through the KV cache); train-time length masking goes "
+        "through the blockwise-scan sdpa path")
+
+
+_flash_cached.defvjp(_flash_cached_vjp_fwd, _flash_cached_vjp_bwd)
+
+
+def supports_cached(seq_q, seq_k, head_dim=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Shape gate for the length-masked kernel: both sequence dims must tile
+    into 128-aligned blocks (decode's seq_q=1 and sub-lane prefill chunks
+    route to the blockwise XLA scan instead)."""
+    return _pick_block(seq_q, block_q) > 0 and _pick_block(seq_k, block_k) > 0
+
+
+def flash_attention_cached(q, k, v, q_pos, kv_len=None, *, scale=None,
+                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                           interpret=None):
+    """Length-masked flash attention over a static-shape KV cache.
+
+    Args:
+      q, k, v: ``(batch, seq, heads, head_dim)`` (paddle layout); ``k``/``v``
+        are full cache buffers of ``max_len`` rows.
+      q_pos: int32 ``(batch, seq_q)`` absolute cache position of each query
+        row; key slot ``j`` attends iff ``j <= q_pos[b, i]``.
+      kv_len: optional int32 ``(batch,)`` exclusive bound of valid cache
+        rows (``None`` -> all ``seq_k`` rows writable-valid).
+
+    Forward-only: serving's prefill / chunked-prefill / speculative-verify
+    steps. Returns ``(batch, seq_q, heads, head_dim)``.
+    """
+    from ...framework.flags import flag_value
+    from . import interpret_requested
+
+    if interpret is None:
+        interpret = interpret_requested()
+    block_q = flag_value("flash_attention_block_q") or block_q
+    block_k = flag_value("flash_attention_block_k") or block_k
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    if not (block_q and block_k):
+        raise ValueError(
+            f"flash_attention_cached needs 128-aligned sequence blocks: "
+            f"seq_q={sq}, seq_k={sk}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    qpos = jnp.broadcast_to(
+        jnp.asarray(q_pos, jnp.int32)[:, None, :, None],
+        (b, 1, sq, STAT_LANES))
+    klen = (jnp.full((b, 1), sk, jnp.int32) if kv_len is None
+            else jnp.asarray(kv_len, jnp.int32).reshape(b, 1))
+    out = _flash_cached(qt, kt, vt, qpos, klen, float(scale), int(block_q),
+                        int(block_k), bool(interpret))
+    return jnp.swapaxes(out, 1, 2)
+
+
 def flash_attention(q, k, v, bias=None, *, causal=False, scale=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
                     interpret=None, bias_grad=True,

@@ -54,6 +54,7 @@ import jax.numpy as jnp
 from ..fault import inject as _inject
 from ..framework.tensor import Tensor
 from ..jit.functionalize import CompiledStep
+from ..nn.functional import LengthMask
 from ..profiler import telemetry as _telemetry
 from ..profiler import tracing as _tracing
 from .kv_cache import (
@@ -242,15 +243,15 @@ class GenerationEngine:
             i = jnp.arange(bucket, dtype=jnp.int32)
             # causal within the chunk AND key < prompt length: padded tail
             # queries produce garbage logits which are never read (the last
-            # valid position is sliced out below)
-            valid = (i[None, :] <= i[:, None]) & (i[None, :] < ln)
-            mask = jnp.where(valid, 0.0, MASK_MIN)[None, None, :, :]
-            mask = mask.astype(jnp.float32)
+            # valid position is sliced out below). The LengthMask carries
+            # (q_pos, kv_len) so the blockwise/Pallas attention paths never
+            # materialize the [1, 1, bucket, bucket] score mask.
+            lmask = LengthMask(i[None, :], ln[None])
             views = [PrefillView(cache.ks[l], cache.vs[l], sl)
                      for l in range(len(cache.ks))]
             logits, views = model(
                 tokens, position_ids=Tensor(i[None, :]),
-                attn_mask=Tensor(mask), cache=views)
+                attn_mask=lmask, cache=views)
             lv = _leaf(logits)  # [1, bucket, vocab]
             # next-token logits live at the last VALID position, not the
             # padded chunk end — a traced dynamic_slice keeps it shape-stable
@@ -281,15 +282,14 @@ class GenerationEngine:
             chunk = tokens.shape[1]
             i = jnp.arange(chunk, dtype=jnp.int32)
             pos = of + i
-            key_idx = jnp.arange(max_len, dtype=jnp.int32)
-            valid = key_idx[None, :] <= pos[:, None]  # [chunk, max_len]
-            mask = jnp.where(valid, 0.0, MASK_MIN)[None, None]
-            mask = mask.astype(jnp.float32)
+            # key j is valid for chunk row i iff j <= of + i — exactly the
+            # LengthMask q_pos semantics over the slot's full cached row
+            lmask = LengthMask(pos[None, :])
             views = [ChunkView(cache.ks[l], cache.vs[l], sl, of)
                      for l in range(len(cache.ks))]
             logits, views = model(
                 tokens, position_ids=Tensor(pos[None, :]),
-                attn_mask=Tensor(mask), cache=views)
+                attn_mask=lmask, cache=views)
             lv = _leaf(logits)  # [1, chunk, vocab]
             # only meaningful on the FINAL chunk (the host reads it then);
             # padded tail queries beyond chunk_len produce garbage logits
@@ -316,15 +316,14 @@ class GenerationEngine:
             # that slot's own position; shapes NEVER vary step to step
             ln = _leaf(cache.lengths).astype(jnp.int32)
             pos = jnp.minimum(ln, max_len - 1)  # [b]
-            kidx = jnp.arange(max_len, dtype=jnp.int32)
-            valid = kidx[None, :] <= pos[:, None]  # [b, max_len]
-            mask = jnp.where(valid, 0.0, MASK_MIN).astype(jnp.float32)
-            mask = mask[:, None, None, :]  # [b, 1, 1, max_len]
+            # each slot's single query row sits at its own position; keys
+            # j <= pos[b] are valid — no [b, 1, 1, max_len] mask tensor
+            lmask = LengthMask(pos[:, None])
             views = [DecodeView(cache.ks[l], cache.vs[l], pos)
                      for l in range(len(cache.ks))]
             logits, views = model(
                 tokens, position_ids=Tensor(pos[:, None]),
-                attn_mask=Tensor(mask), cache=views)
+                attn_mask=lmask, cache=views)
             last = _leaf(logits)[:, -1]  # [b, vocab]
             # token selection ON DEVICE: only [b] int32 (+ the rotated
             # keys) crosses back to the host, never the [b, vocab] logits
@@ -358,15 +357,14 @@ class GenerationEngine:
             pos0 = jnp.minimum(ln, max_len - W)  # [b]
             offs = jnp.arange(W, dtype=jnp.int32)
             pos = pos0[:, None] + offs[None, :]  # [b, W]
-            kidx = jnp.arange(max_len, dtype=jnp.int32)
-            valid = kidx[None, None, :] <= pos[:, :, None]  # [b, W, max_len]
-            mask = jnp.where(valid, 0.0, MASK_MIN).astype(jnp.float32)
-            mask = mask[:, None]  # [b, 1, W, max_len]
+            # window row i of slot b queries position pos[b, i]; keys
+            # j <= pos[b, i] are valid — no [b, 1, W, max_len] mask tensor
+            lmask = LengthMask(pos)
             views = [DecodeView(cache.ks[l], cache.vs[l], pos0)
                      for l in range(len(cache.ks))]
             logits, views = model(
                 tokens, position_ids=Tensor(pos),
-                attn_mask=Tensor(mask), cache=views)
+                attn_mask=lmask, cache=views)
             lv = _leaf(logits).astype(jnp.float32)  # [b, W, vocab]
             # greedy[b, i] = the verifier's own next token GIVEN the
             # window prefix up to i — the host accepts the longest draft
@@ -701,6 +699,17 @@ class GenerationEngine:
         tokens = np.zeros((self.max_batch, self.spec_k + 1), np.int32)
         return (tokens, self._example_cache(lengths),
                 *self._example_sampling_args())
+
+    def example_chunk_args(self, lengths, off=0):
+        """Shape-faithful ``(tokens, chunk_len, off, slot, cache)``
+        example batch for linting the chunked-prefill step — the config
+        the long-context mem-lint zoo crosschecks (chunk queries against
+        the full ``max_len`` cached row through the blockwise path)."""
+        if self._chunk_step is None:
+            raise RuntimeError("engine was built without prefill_chunk")
+        tokens = np.zeros((1, self.prefill_chunk), np.int32)
+        return (tokens, np.int32(self.prefill_chunk), np.int32(int(off)),
+                np.int32(0), self._example_cache(lengths))
 
 
 class EncoderScorer:

@@ -17,11 +17,17 @@ exactly once, and with the buffers passed through ``CompiledStep``'s
 a fused in-place dynamic-update-slice, not a gather/concat chain).
 
 Masking carries the variable part: attention always runs over the full
-``max_len`` keys and an additive mask built from the per-slot lengths
-zeroes out the not-yet-written tail. Correctness invariant: position ``j``
-of a slot's buffer holds garbage only while ``j >= length`` — and the mask
-admits exactly ``j <= position-of-the-query`` — so garbage is never
-attended to and is overwritten the moment the sequence reaches it.
+``max_len`` keys and the per-slot lengths mask out the not-yet-written
+tail. The engine's step bodies express that as a
+``functional.LengthMask`` (ISSUE 15) — a description of the valid
+region, not a materialized ``[b, 1, q, max_len]`` tensor — so at long
+``max_len`` sdpa routes to the blockwise online-softmax KV scan (or the
+Pallas flash cached kernel on TPU) and the O(q·max_len) score matrix is
+never built; short caches fall back to the same additive mask as before.
+Correctness invariant either way: position ``j`` of a slot's buffer holds
+garbage only while ``j >= length`` — and the mask admits exactly
+``j <= position-of-the-query`` — so garbage is never attended to and is
+overwritten the moment the sequence reaches it.
 """
 from __future__ import annotations
 

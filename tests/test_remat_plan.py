@@ -1,0 +1,286 @@
+"""Selective-remat autopilot (ISSUE 15): mem-lint ``delta_if_remat``,
+the greedy site planner, block wrapping through fleet recompute, and the
+``Model.prepare(remat=...)`` / auto_parallel ``Engine(remat=...)`` knobs.
+
+Contracts under test:
+  * ``delta_if_remat`` — predicted peak reduction is non-negative, never
+    exceeds the bytes of the chosen buffers (the relive window keeps the
+    backward-consumer recompute honest), and is 0 for params/outputs;
+  * ``plan_remat`` — the greedy planner gets the PREDICTED peak under an
+    achievable budget and chooses nothing under a generous one;
+  * ``auto_remat`` — wraps repeated blocks until the RE-TRACED peak fits;
+    the first train step's loss is bit-identical to the unwrapped model
+    (jax.checkpoint changes memory, never math) and ``clear_remat``
+    restores the original forwards;
+  * the ``hbm-remat-candidate`` finding quotes the planner's
+    ``delta_if_remat`` prediction and points at the autopilot knob.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import analysis
+from paddle_tpu.analysis import remat_plan
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit.functionalize import CompiledStep
+from paddle_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_tpu.utils import unique_name
+
+
+def _mlp_step(batch=16, din=32, dh=64):
+    with unique_name.guard():
+        paddle.seed(0)
+        l1 = paddle.nn.Linear(din, dh)
+        l2 = paddle.nn.Linear(dh, din)
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1,
+        parameters=list(l1.parameters()) + list(l2.parameters()))
+
+    def train_step(x, y):
+        h = paddle.nn.functional.relu(l1(x))
+        out = l2(h)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = CompiledStep(train_step, stateful=[l1, l2, opt],
+                        donate_state=True)
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.randn(batch, din).astype(np.float32))
+    y = Tensor(rng.randn(batch, din).astype(np.float32))
+    return step, (x, y)
+
+
+_GPT_CFG = dict(vocab_size=128, hidden_size=64, num_layers=4, num_heads=2,
+                max_position_embeddings=128, hidden_dropout=0.0,
+                attention_dropout=0.0)
+
+
+def _gpt_and_step(seed=0):
+    with unique_name.guard():
+        paddle.seed(seed)
+        model = GPTForCausalLM(GPTConfig(**_GPT_CFG))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+    def make_step():
+        def train_step(ids, labels):
+            loss = model.loss(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return CompiledStep(train_step, stateful=[model, opt],
+                            donate_state=True)
+
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, 128, (2, 128)).astype(np.int64))
+    return model, make_step, (ids, ids)
+
+
+# ---------------------------------------------------------------------------
+# delta_if_remat
+# ---------------------------------------------------------------------------
+def test_delta_if_remat_bounds():
+    step, (x, y) = _mlp_step()
+    tl = analysis.analyze_memory(step, x, y)
+    cands = tl.long_lived(1.0, 0.0)
+    assert cands, "tiny MLP must expose at least one long-lived temp"
+    keys = [b.key for b in cands]
+    d = tl.delta_if_remat(keys)
+    assert 0.0 <= d <= sum(b.nbytes for b in cands)
+    # single-key form accepts a bare int and is no better than the union
+    assert 0.0 <= tl.delta_if_remat(keys[0]) <= d + 1e-9
+
+
+def test_delta_if_remat_ignores_params_and_outputs():
+    step, (x, y) = _mlp_step()
+    tl = analysis.analyze_memory(step, x, y)
+    skip = [b.key for b in tl.buffers
+            if b.kind != "temp" or b.is_output or b.aliases is not None]
+    assert skip
+    assert tl.delta_if_remat(skip) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# candidate grouping + the greedy planner
+# ---------------------------------------------------------------------------
+def test_candidate_sites_group_repeated_layers():
+    _, make_step, args = _gpt_and_step()
+    tl = analysis.analyze_memory(make_step(), *args)
+    sites = remat_plan.candidate_sites(tl, min_bytes=1.0, min_span=0.0)
+    assert sites
+    # sorted largest-first, and the 4 identical blocks share source lines:
+    # at least one site aggregates buffers from several layers
+    assert sites == sorted(sites, key=lambda s: -s.nbytes)
+    assert max(s.n_buffers for s in sites) >= 2
+
+
+def test_plan_remat_meets_achievable_budget():
+    _, make_step, args = _gpt_and_step()
+    tl = analysis.analyze_memory(make_step(), *args)
+    full = remat_plan.plan_remat(tl, budget_bytes=None, min_bytes=1.0,
+                                 min_span=0.0)
+    assert full.peak_after <= full.peak_before
+    assert full.ok  # no budget: always "fits"
+    floor = full.peak_after
+    budget = floor + 0.5 * (tl.peak_bytes - floor)
+    plan = remat_plan.plan_remat(tl, budget_bytes=budget, min_bytes=1.0,
+                                 min_span=0.0)
+    assert plan.ok and plan.sites
+    assert plan.peak_after <= budget
+    assert plan.delta > 0
+    d = plan.as_dict()
+    assert d["ok"] and d["sites"] and "peak_after" in d
+    assert "fits" in plan.table()
+
+
+def test_plan_remat_generous_budget_chooses_nothing():
+    _, make_step, args = _gpt_and_step()
+    tl = analysis.analyze_memory(make_step(), *args)
+    plan = remat_plan.plan_remat(tl, budget_bytes=2.0 * tl.peak_bytes,
+                                 min_bytes=1.0, min_span=0.0)
+    assert plan.ok and not plan.sites
+    assert plan.peak_after == plan.peak_before
+
+
+def test_plan_remat_impossible_budget_reports_not_ok():
+    _, make_step, args = _gpt_and_step()
+    tl = analysis.analyze_memory(make_step(), *args)
+    plan = remat_plan.plan_remat(tl, budget_bytes=1.0, min_bytes=1.0,
+                                 min_span=0.0)
+    assert not plan.ok
+    assert "DOES NOT FIT" in plan.table()
+
+
+# ---------------------------------------------------------------------------
+# application: wrapping, parity, unwrap
+# ---------------------------------------------------------------------------
+def test_find_repeated_blocks_is_the_decoder_stack():
+    model, _, _ = _gpt_and_step()
+    blocks = remat_plan.find_repeated_blocks(model)
+    assert len(blocks) == 4
+    assert all(type(b).__name__ == "GPTDecoderLayer" for b in blocks)
+
+
+def test_auto_remat_wraps_until_retraced_peak_fits():
+    model, make_step, args = _gpt_and_step()
+    tl0 = analysis.analyze_memory(make_step(), *args)
+    budget = 0.7 * tl0.peak_bytes
+    rep = analysis.auto_remat(model, budget, make_step, args,
+                              name="gpt_remat_test")
+    try:
+        assert rep.ok, rep.table()
+        assert rep.blocks_wrapped >= 1
+        assert rep.blocks_total == 4
+        assert rep.peak_after <= budget
+        # the reported peak is the applied program's own timeline
+        assert rep.timeline.peak_bytes == rep.peak_after
+        assert rep.as_dict()["blocks_wrapped"] == rep.blocks_wrapped
+    finally:
+        n = remat_plan.clear_remat(model)
+    assert n == rep.blocks_wrapped
+
+
+def test_remat_loss_bit_identical_and_clear_restores():
+    model, make_step, args = _gpt_and_step(seed=3)
+    base = float(np.asarray(make_step()(*args)._value))
+
+    model2, make_step2, args2 = _gpt_and_step(seed=3)
+    tl0 = analysis.analyze_memory(make_step2(), *args2)
+    rep = analysis.auto_remat(model2, 0.7 * tl0.peak_bytes, make_step2,
+                              args2, name="gpt_remat_parity")
+    assert rep.blocks_wrapped >= 1
+    got = float(np.asarray(make_step2()(*args2)._value))
+    assert got == base, "jax.checkpoint must not change the math"
+    remat_plan.clear_remat(model2)
+    assert not any(getattr(l, "_remat_wrapped", False)
+                   for l in model2.sublayers(include_self=True))
+
+
+def test_wrap_block_bypasses_eval_and_cache_calls():
+    model, _, _ = _gpt_and_step(seed=5)
+    block = remat_plan.find_repeated_blocks(model)[0]
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.randn(2, 8, 64).astype(np.float32))
+    model.eval()
+    want = np.asarray(block(x)._value)
+    remat_plan.wrap_block(block)
+    assert block._remat_wrapped
+    remat_plan.wrap_block(block)  # idempotent
+    got = np.asarray(block(x)._value)  # eval mode: original path
+    np.testing.assert_array_equal(got, want)
+    remat_plan.unwrap_block(block)
+    assert not block._remat_wrapped
+
+
+def test_resolve_budget_forms():
+    assert remat_plan.resolve_budget(None) is None
+    assert remat_plan.resolve_budget(False) is None
+    assert remat_plan.resolve_budget(123) == 123.0
+    cap = remat_plan.resolve_budget("auto")
+    assert cap is None or cap > 0  # None on plain XLA:CPU
+
+
+# ---------------------------------------------------------------------------
+# the user-facing knobs
+# ---------------------------------------------------------------------------
+def test_model_prepare_remat_applies_once():
+    with unique_name.guard():
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig(**_GPT_CFG))
+    m = paddle.Model(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (2, 128)).astype(np.int64)
+
+    import paddle_tpu.nn.functional as F
+
+    def ce(logits, labels):
+        return F.cross_entropy(
+            logits.reshape([-1, 128]), labels.reshape([-1])).mean()
+
+    m.prepare(opt, loss=ce, remat=int(40 << 20))
+    assert m._remat == int(40 << 20) and not m._remat_applied
+    (l0,) = m.train_batch([ids], [ids.astype(np.int64)])
+    assert np.isfinite(l0)
+    assert m._remat_applied
+    rep = m._remat_report
+    assert rep is not None and rep.blocks_total == 4
+    # second batch must not re-apply
+    m.train_batch([ids], [ids])
+    assert m._remat_report is rep
+    remat_plan.clear_remat(model)
+
+
+def test_engine_remat_kwarg_stored():
+    net = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+    eng = Engine(model=net, loss=paddle.nn.MSELoss(), optimizer=opt,
+                 remat=int(1 << 30))
+    assert eng._remat == int(1 << 30)
+    assert eng.remat_report_ is None and not eng._remat_applied
+
+
+# ---------------------------------------------------------------------------
+# the lint finding quotes the autopilot
+# ---------------------------------------------------------------------------
+def test_remat_candidate_finding_quotes_predicted_delta():
+    step, (x, y) = _mlp_step()
+    rep = analysis.lint_step(step, x, y,
+                             config={"remat_min_bytes": 1.0,
+                                     "remat_min_span": 0.0})
+    hits = rep.by_rule("hbm-remat-candidate")
+    assert hits
+    f = hits[0]
+    assert "rematerializing" in f.message
+    assert f.data.get("delta_if_remat") is not None
+    assert f.data["delta_if_remat"] >= 0.0
+    assert 'remat="auto"' in f.hint and "plan_remat" in f.hint

@@ -138,10 +138,31 @@ def extract_multichip(doc):
     return out
 
 
+def extract_longctx(doc):
+    """LONGCTX rounds: per-seq long-context throughput plus the PREDICTED
+    HBM peak of the train step (the static mem-lint series — honest on
+    CPU, where the 16k/32k rows never execute). A peak that creeps up at
+    fixed batch forecloses the context-length headroom the blockwise
+    attention path bought."""
+    out = {}
+    for row in doc.get("results") or []:
+        seq = row.get("seq")
+        if not isinstance(seq, (int, float)):
+            continue
+        v = row.get("tokens_per_sec")
+        if isinstance(v, (int, float)):
+            out[f"tokens_per_sec@{int(seq)}"] = (float(v), "higher")
+        p = row.get("hbm_peak_bytes")
+        if isinstance(p, (int, float)):
+            out[f"hbm_peak_bytes@{int(seq)}"] = (float(p), "lower")
+    return out
+
+
 SERIES = (
     ("bench", "BENCH_r*.json", extract_bench),
     ("serve", "SERVE_r*.json", extract_serve),
     ("multichip", "MULTICHIP_r*.json", extract_multichip),
+    ("longctx", "LONGCTX_r*.json", extract_longctx),
 )
 
 

@@ -27,6 +27,14 @@ telemetry block carries ``serve.spec_acceptance_rate`` plus the
 ``recompile_whitelist`` marker that lets bench_sentinel hard-gate
 ``recompile_count`` as an 'equal' contract metric.
 
+Long-context raw speed (ISSUE 15): ``--long-prompt`` switches to the
+long-prompt leg — 4x max_len and prefill buckets, every prompt in the
+top bucket — so prefill, chunked prefill, and decode all route through
+the blockwise cached attention path (length-masked KV-block scan / the
+Pallas flash cached kernel on TPU) instead of the dense additive mask.
+All the contract assertions below still apply verbatim: the blockwise
+route must stay O(1)-decode, recompile-free, and byte-identical greedy.
+
 Emits one JSON line and (with ``--artifact``) a SERVE_r*.json. ``--smoke``
 runs a tiny CPU config and hard-asserts the telemetry contract — wired
 into ``tools/run_tests.sh`` as a CI gate.
@@ -48,13 +56,14 @@ def _pctl(xs, q):
     return float(np.percentile(np.asarray(xs, np.float64), q)) if xs else 0.0
 
 
-def build_model(smoke):
+def build_model(smoke, long_prompt=False):
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
     if smoke:
         cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
-                        num_heads=2, max_position_embeddings=64,
+                        num_heads=2,
+                        max_position_embeddings=256 if long_prompt else 64,
                         hidden_dropout=0.0, attention_dropout=0.0)
     else:
         # GPT-2 small (124M) — the same flagship config bench.py trains
@@ -67,11 +76,16 @@ def build_model(smoke):
     return cfg, model
 
 
-def make_requests(cfg, n, max_new, buckets, seed):
+def make_requests(cfg, n, max_new, buckets, seed, long_prompt=False):
     from paddle_tpu.serving import Request
 
     rng = np.random.RandomState(seed)
-    lo, hi = 4, max(5, buckets[-1] // 2)
+    if long_prompt:
+        # every prompt lands in the top bucket: prefill runs at blockwise
+        # lengths instead of the short-prompt regime
+        lo, hi = buckets[-1] // 2 + 1, buckets[-1]
+    else:
+        lo, hi = 4, max(5, buckets[-1] // 2)
     return [Request(prompt=rng.randint(0, cfg.vocab_size,
                                        int(rng.randint(lo, hi))).tolist(),
                     max_new_tokens=max_new)
@@ -198,7 +212,7 @@ def lint_decode(eng):
 
 
 def run_prompt_len_sweep(cfg, model, max_len, buckets, concurrency,
-                         spec_k, prefill_chunk, seed):
+                         spec_k, prefill_chunk, seed, lengths=None):
     """TTFT vs prompt length, at queue pressure (2× concurrency, every
     prompt the same length L): with one-shot prefill the second wave's
     TTFT inherits every first-wave prefill whole, so p95 TTFT scales
@@ -213,7 +227,7 @@ def run_prompt_len_sweep(cfg, model, max_len, buckets, concurrency,
                            prefill_chunk=prefill_chunk or None)
     warm_engine(eng, buckets, max_len, concurrency)
     max_new = 8  # short decode budget: the sweep isolates TTFT
-    lengths = [x for x in (4, 8, 16, 24, 32)
+    lengths = [x for x in (lengths or (4, 8, 16, 24, 32))
                if x <= buckets[-1] and x + max_new <= max_len]
     rng = np.random.RandomState(seed)
     rows = []
@@ -285,6 +299,11 @@ def main(argv=None):
     ap.add_argument("--prompt-len-sweep", action="store_true",
                     help="append TTFT-vs-prompt-length rows to the "
                          "artifact (sub-linear growth is the contract)")
+    ap.add_argument("--long-prompt", action="store_true",
+                    help="long-prompt leg (ISSUE 15): 4x max_len and "
+                         "buckets, every prompt in the top bucket, so "
+                         "prefill/decode take the blockwise cached-"
+                         "attention route instead of the dense mask")
     ap.add_argument("--artifact", default=None)
     ap.add_argument("--chaos", action="store_true",
                     help="also run tools/chaos_serve.py and embed its "
@@ -301,14 +320,27 @@ def main(argv=None):
     prefill_chunk = ((4 if args.smoke else 16) if args.prefill_chunk is None
                      else max(0, args.prefill_chunk))
 
-    cfg, model = build_model(args.smoke)
+    cfg, model = build_model(args.smoke, long_prompt=args.long_prompt)
     # size the cache to the workload: largest prompt (buckets[-1]/2) plus
     # the generation budget — decode attention + cache traffic scale with
     # max_len, so capacity beyond the worst case is pure per-step cost
-    max_len = 64 if args.smoke else 32 + max_new
-    buckets = (8, 16) if args.smoke else (16, 64)
+    if args.long_prompt:
+        # long-prompt leg: the KV lengths must cross the blockwise route.
+        # The full config reaches the stock min-kv threshold (1024) on its
+        # own; the smoke config is held small, so lower the threshold to
+        # its bucket scale — same route, CPU-sized shapes
+        max_len = 256 if args.smoke else cfg.max_position_embeddings
+        buckets = (64, 128) if args.smoke else (256, 512)
+        if args.smoke:
+            from paddle_tpu.framework.flags import set_flags
 
-    requests = make_requests(cfg, n_req, max_new, buckets, args.seed)
+            set_flags({"blockwise_attention_min_kv": 64})
+    else:
+        max_len = 64 if args.smoke else 32 + max_new
+        buckets = (8, 16) if args.smoke else (16, 64)
+
+    requests = make_requests(cfg, n_req, max_new, buckets, args.seed,
+                             long_prompt=args.long_prompt)
     # identical prompts for both runs (Request objects are stateful):
     from paddle_tpu.serving import Request
 
@@ -342,6 +374,7 @@ def main(argv=None):
             "concurrency": args.concurrency, "requests": n_req,
             "max_new_tokens": max_new,
             "spec_k": spec_k, "prefill_chunk": prefill_chunk,
+            "long_prompt": bool(args.long_prompt),
         },
         "sequential": sequential,
         "continuous": continuous,
@@ -351,9 +384,14 @@ def main(argv=None):
     if args.prompt_len_sweep:
         # runs after the telemetry block is captured so the sweep's own
         # engine/compiles cannot perturb the contract counters above
+        sweep_lengths = None
+        if args.long_prompt:
+            sweep_lengths = (buckets[0] // 2, buckets[0],
+                             (buckets[0] + buckets[-1]) // 2, buckets[-1])
         sweep = run_prompt_len_sweep(cfg, model, max_len, buckets,
                                      args.concurrency, spec_k,
-                                     prefill_chunk, args.seed)
+                                     prefill_chunk, args.seed,
+                                     lengths=sweep_lengths)
         result["prompt_len_sweep"] = sweep
     chaos = None
     if args.chaos:

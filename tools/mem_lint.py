@@ -60,6 +60,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 #: well under the undonated long-context peak, well over the clean zoo's
 FIXTURE_CAPACITY = 16 << 20
 
+#: the long-context gate's synthetic budget: the blockwise longctx
+#: timeline predicts ~45 MiB and fits, the einsum score matrix pushes the
+#: SAME shapes to ~80 MiB and must blow it (run_tests.sh asserts both)
+LONGCTX_CAPACITY = 56 << 20
+
 
 def build_dp_mp(fixture=None):
     """Megatron-style TP MLP train step under a dp×mp mesh, sized so real
@@ -254,15 +259,102 @@ def build_undonated_longctx(fixture=None):
     return step, (x, y), None, False  # static-only: the fixture never runs
 
 
+def build_longctx(fixture=None):
+    """Long-context GPT train step at seq 1024 — over the blockwise
+    threshold, so causal training attention runs the KV-block scan (ISSUE
+    15) instead of the O(seq²) einsum score matrix. Measurable on
+    XLA:CPU: the predicted peak must agree with ``memory_analysis`` and
+    never under-predict. ``--disable-blockwise`` forces the einsum path
+    on the SAME shapes — the run_tests.sh gate lints both under one
+    ``--capacity`` that only the blockwise timeline fits."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit.functionalize import CompiledStep
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.utils import unique_name
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=2,
+                    num_heads=2, max_position_embeddings=1024,
+                    hidden_dropout=0.0, attention_dropout=0.0)
+    with unique_name.guard():
+        paddle.seed(0)
+        model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def train_step(ids, labels):
+        loss = model.loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step.__name__ = "longctx_train_step"
+    step = CompiledStep(train_step, stateful=[model, opt],
+                        donate_state=True)
+    rng = np.random.RandomState(0)
+    ids = Tensor(rng.randint(0, cfg.vocab_size, (1, 1024))
+                 .astype(np.int64))
+    return step, (ids, ids), None, True
+
+
+def build_serve_chunk(fixture=None):
+    """The chunked-prefill serving step over a 1024-row KV cache: chunk
+    queries attend the slot's FULL cached row through the length-masked
+    blockwise path — the serving-side long-context crosscheck target."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import GenerationEngine
+    from paddle_tpu.utils import unique_name
+
+    with unique_name.guard():
+        paddle.seed(0)
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+            max_position_embeddings=1024, hidden_dropout=0.0,
+            attention_dropout=0.0))
+    model.eval()
+    eng = GenerationEngine(model, max_batch=2, max_len=1024,
+                           prefill_buckets=(128,), prefill_chunk=128,
+                           freeze_weights=False)
+    return (eng.chunk_step, tuple(eng.example_chunk_args([256], off=256)),
+            None, True)
+
+
+def run_remat_fixture(capacity=None, out=sys.stdout):
+    """``--fixture remat-plan``: the selective-remat planner must get the
+    longctx step's PREDICTED peak under the budget (default: 70% of the
+    baseline peak). Returns 0 on success, 1 when the plan misses — the
+    run_tests.sh gate asserts 0."""
+    from paddle_tpu import analysis
+    from paddle_tpu.analysis import remat_plan
+
+    step, batch, _, _ = build_longctx()
+    tl = analysis.analyze_memory(step, *batch)
+    budget = float(capacity) if capacity else 0.7 * tl.peak_bytes
+    plan = remat_plan.plan_remat(tl, budget_bytes=budget,
+                                 min_bytes=1 << 16, min_span=0.2)
+    print(f"\n== remat-plan fixture ({step.name}) ==", file=out)
+    print(plan.table(), file=out)
+    ok = plan.ok and plan.sites and plan.peak_after <= budget
+    print(f"remat-plan fixture: predicted {tl.peak_bytes:.0f} -> "
+          f"{plan.peak_after:.0f} bytes under budget {budget:.0f} -> "
+          f"{'OK' if ok else 'FAIL'}", file=out)
+    return 0 if ok else 1
+
+
 ZOO = {
     "dp-mp": build_dp_mp,
     "serve-decode": build_serve_decode,
     "dp-plain": build_dp_plain,
     "dp-zero": build_dp_zero,
+    "longctx": build_longctx,
+    "serve-chunk": build_serve_chunk,
 }
 
 FIXTURES = {
     "undonated-longctx": build_undonated_longctx,
+    "remat-plan": run_remat_fixture,  # special-cased: a planner gate
 }
 
 
@@ -315,7 +407,8 @@ def lint_zoo(models, fixture=None, measure=False, capacity=None,
 def run(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--models", nargs="+",
-                    default=["dp-mp", "serve-decode", "dp-plain", "dp-zero"],
+                    default=["dp-mp", "serve-decode", "dp-plain", "dp-zero",
+                             "longctx", "serve-chunk"],
                     choices=sorted(ZOO))
     ap.add_argument("--jsonl", default=None,
                     help="write one JSON object per finding to this path")
@@ -339,21 +432,44 @@ def run(argv=None):
                     choices=["error", "warning", "never"],
                     help="exit 1 when findings at/above this severity "
                          "exist")
+    ap.add_argument("--disable-blockwise", action="store_true",
+                    help="force the einsum attention path (sets the "
+                         "disable_blockwise_attention flag) — the "
+                         "run_tests.sh long-context gate lints the SAME "
+                         "config both ways under one --capacity")
     ap.add_argument("--smoke", action="store_true",
-                    help="CI gate: clean zoo with --measure must pass AND "
-                         "the fixture must exit 1")
+                    help="CI gate: clean zoo with --measure must pass, the "
+                         "undonated fixture must exit 1, the longctx config "
+                         "must fit a capacity the einsum path blows, and "
+                         "the remat planner must hit its budget")
     args = ap.parse_args(argv)
 
     if args.smoke:
         clean = run(["--measure"])
         fixture = run(["--fixture", "undonated-longctx"])
-        ok = clean == 0 and fixture == 1
+        # the ISSUE 15 long-context gate: one synthetic HBM budget that
+        # the blockwise timeline fits and the einsum score matrix blows
+        bw = run(["--models", "longctx", "--capacity",
+                  str(LONGCTX_CAPACITY)])
+        es = run(["--models", "longctx", "--capacity",
+                  str(LONGCTX_CAPACITY), "--disable-blockwise"])
+        remat = run(["--fixture", "remat-plan"])
+        ok = (clean == 0 and fixture == 1 and bw == 0 and es == 1
+              and remat == 0)
         print(f"\nmem lint smoke: clean-zoo rc={clean} (want 0), "
-              f"fixture rc={fixture} (want 1) -> "
-              f"{'OK' if ok else 'FAIL'}")
+              f"fixture rc={fixture} (want 1), longctx-blockwise rc={bw} "
+              f"(want 0), longctx-einsum rc={es} (want 1), remat-plan "
+              f"rc={remat} (want 0) -> {'OK' if ok else 'FAIL'}")
         return 0 if ok else 1
 
+    if args.disable_blockwise:
+        from paddle_tpu.framework.flags import set_flags
+
+        set_flags({"disable_blockwise_attention": True})
+
     capacity = args.capacity
+    if args.fixture == "remat-plan":
+        return run_remat_fixture(capacity)
     if args.fixture and capacity is None:
         capacity = FIXTURE_CAPACITY
 

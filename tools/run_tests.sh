@@ -142,11 +142,16 @@ PYEOF
       --fixture mismatched-constraint > /dev/null 2>&1; then
     echo "shard_lint missed the mismatched-constraint fixture" >&2; exit 1
   fi
-  # mem-lint gate (ISSUE 12): per-eqn liveness over the zoo — the clean
-  # configs must lint with zero errors AND the predicted HBM peak must
-  # agree with compiled.memory_analysis() within rtol (--measure, never
-  # under-predicting), while the undonated long-context fixture MUST be
-  # flagged over its injected budget (exit 1); --smoke runs both legs
+  # mem-lint gate (ISSUE 12 + 15): per-eqn liveness over the zoo — the
+  # clean configs (incl. the blockwise longctx train step and the
+  # chunked-prefill serving step) must lint with zero errors AND the
+  # predicted HBM peak must agree with compiled.memory_analysis() within
+  # rtol (--measure, never under-predicting); the undonated long-context
+  # fixture MUST be flagged over its injected budget (exit 1); the
+  # longctx config must FIT a synthetic capacity that the einsum path
+  # (--disable-blockwise) must BLOW on the same shapes; and the
+  # selective-remat planner must get the predicted peak under its budget
+  # (--fixture remat-plan, exit 0); --smoke runs every leg
   JAX_PLATFORMS=cpu python tools/mem_lint.py --smoke
   # ZeRO dp-parity gate (ISSUE 14): the dp=2 sharded-update smoke bench
   # must hold loss parity against replicated Adam (--parity asserts it),
@@ -173,6 +178,11 @@ PYEOF
   # variants, speculation actually engaged, zero shape-churn findings
   JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke \
     --artifact "$SMOKE_DIR/serve_smoke.json"
+  # long-prompt serving leg (ISSUE 15): 4x max_len/buckets, every prompt
+  # in the top bucket, blockwise cached attention forced on at smoke
+  # scale — the SAME telemetry contract must hold on the blockwise route
+  JAX_PLATFORMS=cpu python tools/bench_serve.py --smoke --long-prompt \
+    --artifact "$SMOKE_DIR/serve_smoke_longprompt.json"
   # serving chaos gate (ISSUE 10 + 13): flood the scheduler (speculation
   # + chunked prefill ON) under injected OOM/transient-error/stall plus
   # draft and mid-verify faults, and hard-assert the resilience contract
