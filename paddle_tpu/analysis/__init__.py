@@ -30,13 +30,17 @@ from .graph_lint import (  # noqa: F401
 )
 from .crosscheck import (  # noqa: F401
     COMM_RTOL,
+    MEM_ATOL,
     MEM_RTOL,
+    MEM_RTOL_UNFUSED,
     RETRACE_RULES,
     crosscheck_comm,
     crosscheck_mem,
     crosscheck_telemetry,
 )
 from .rules import RULES, register_rule, rule_ids  # noqa: F401
+from . import fusion  # noqa: F401
+from .fusion import FusionPlan, plan_jaxpr  # noqa: F401
 from . import mem_lint  # noqa: F401
 from . import shard_lint  # noqa: F401
 from .mem_lint import (  # noqa: F401
@@ -57,8 +61,9 @@ __all__ = [
     "SEVERITIES", "Finding", "LintReport", "StepGraph", "LINT_DEFAULTS",
     "lint_step", "trace_step", "crosscheck_telemetry", "RETRACE_RULES",
     "crosscheck_comm", "COMM_RTOL", "sarif_report",
-    "crosscheck_mem", "MEM_RTOL",
+    "crosscheck_mem", "MEM_RTOL", "MEM_RTOL_UNFUSED", "MEM_ATOL",
     "RULES", "register_rule", "rule_ids",
+    "fusion", "FusionPlan", "plan_jaxpr",
     "shard_lint", "ShardingAnalysis", "analyze_sharding",
     "mem_lint", "MemoryTimeline", "analyze_memory", "MEM_LINT_DEFAULTS",
     "remat_plan", "RematPlan", "AutoRematReport", "plan_remat",

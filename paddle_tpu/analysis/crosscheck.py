@@ -17,18 +17,39 @@ as ``agrees=False``.
 from __future__ import annotations
 
 __all__ = ["RETRACE_RULES", "crosscheck_telemetry", "crosscheck_comm",
-           "COMM_RTOL", "crosscheck_mem", "MEM_RTOL"]
+           "COMM_RTOL", "crosscheck_mem", "MEM_RTOL", "MEM_RTOL_UNFUSED",
+           "MEM_ATOL"]
 
 #: default relative tolerance for predicted-vs-measured collective bytes
 #: (explicit shard_map collectives are exact; GSPMD propagation is a model)
 COMM_RTOL = 0.10
 
-#: default relative tolerance for predicted-vs-measured HBM peak bytes.
-#: Looser than COMM_RTOL on purpose: the liveness timeline is an upper
-#: bound (XLA fusion elides temporaries the jaxpr materializes, and the
-#: allocator packs lifetimes tighter than per-eqn granularity) — but it
-#: must never UNDER-predict the compiled peak beyond this gate.
-MEM_RTOL = 0.15
+#: default relative tolerance for predicted-vs-measured HBM peak bytes,
+#: for FUSION-AWARE timelines (``mem_lint`` with ``fusion=True``, the
+#: default since ISSUE 18): the :mod:`.fusion` plan removes the
+#: systematic fusion-blindness over-prediction, so the remaining slack is
+#: only XLA buffer-assignment packing lifetimes tighter (or looser — the
+#: measured "temp" term is a heap total, not an optimal live set) than
+#: the timeline's per-eqn granularity. Ratcheted from 0.15 → 0.10 as
+#: certified by the measured zoo crosscheck (tools/mem_lint.py
+#: --measure): every measurable config must agree within
+#: ``rtol*m + MEM_ATOL``, and the timeline must never UNDER-predict the
+#: compiled peak beyond that band.
+MEM_RTOL = 0.10
+
+#: the pre-fusion tolerance, kept for the legacy ``fusion=False`` path:
+#: a fusion-blind timeline legitimately over-predicts by up to this much
+#: (every elementwise temporary priced as live HBM)
+MEM_RTOL_UNFUSED = 0.15
+
+#: absolute slack for the mem crosscheck, in bytes. The measured peak is
+#: XLA buffer-assignment's *heap* total, which carries a small fixed
+#: runtime overhead (scratch buffers, alignment padding, control state)
+#: that no live-set model predicts — on a tiny program (a few hundred KB)
+#: that fixed cost dwarfs any relative tolerance. 64 KiB covers it on
+#: every zoo config without masking a real modelling bug on
+#: realistically-sized programs, where ``MEM_RTOL`` dominates.
+MEM_ATOL = 64 << 10
 
 #: rules whose findings predict >1 compilation of the step
 RETRACE_RULES = frozenset({
@@ -169,14 +190,17 @@ def _peak_bytes_of(obj):
     return float(peak), alias_unavailable
 
 
-def crosscheck_mem(predicted, measured, rtol=MEM_RTOL):
+def crosscheck_mem(predicted, measured, rtol=MEM_RTOL, atol=MEM_ATOL):
     """Join mem-lint's *predicted* HBM peak with XLA's *measured* one
     (``compiled.memory_analysis()`` via devprof).
 
-    The prediction is documented as an upper bound: XLA fusion elides
-    temporaries the abstract timeline materializes, so moderate
-    over-prediction within ``rtol`` is expected — an UNDER-prediction
-    beyond ``rtol`` is a mem-lint bug (``under_predicted=True``).
+    The prediction is documented as an upper bound on the *live set*: the
+    fusion-aware timeline prices only buffers the compiler materializes,
+    so agreement means ``|p - m| <= rtol*m + atol``. The ``atol`` term
+    absorbs the fixed heap overhead (runtime scratch, padding) that makes
+    tiny programs impossible to bound relatively — see ``MEM_ATOL``. An
+    UNDER-prediction beyond the combined band is a mem-lint bug
+    (``under_predicted=True``).
 
     Args:
         predicted: a ``mem_lint.MemoryTimeline`` (or number / dict with
@@ -206,10 +230,11 @@ def crosscheck_mem(predicted, measured, rtol=MEM_RTOL):
                           "(persistent-cache executable): peak is not "
                           "trustworthy, not gating")
         return [row]
+    band = rtol * m + atol
     if m > 0:
         row["ratio"] = p / m
-        row["agrees"] = abs(p - m) <= rtol * m
-        row["under_predicted"] = p < m - rtol * m
+        row["agrees"] = abs(p - m) <= band
+        row["under_predicted"] = p < m - band
     else:
-        row["agrees"] = p == 0
+        row["agrees"] = p <= band
     return [row]

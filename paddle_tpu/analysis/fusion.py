@@ -1,0 +1,301 @@
+"""Static fusion simulation: predict which jaxpr temporaries XLA elides.
+
+The analysis stack's one systematic error is fusion-blindness: the liveness
+timeline (:mod:`.mem_lint`) prices every elementwise temporary as a live
+HBM buffer even where XLA's instruction-fusion pass folds it into its
+consumer's loop body, and shard_lint's traffic proxy reads/writes every
+intermediate once. This module closes the gap with a *conservative*
+simulation of XLA's producer-consumer fusion (arxiv 2301.13062 — the
+instruction-fusion + fusion-merger heuristics), clustering chains of
+elementwise / broadcast / transpose / reshape equations (with reductions
+as absorbing epilogue roots) into fusion groups and classifying every
+intermediate as **fused-away** (XLA certifiably elides the buffer) or
+**materialized** (it hits HBM), with a *reason* for each fusible-producer
+value that still materializes.
+
+Heuristics encoded (each mirrors an XLA rule, always erring toward
+"materialized" — the consumers of this plan keep an upper-bound contract):
+
+* **producer-consumer chains fuse** — a value produced by a fusible
+  (cheap/expensive elementwise or shape) op whose consumers can all absorb
+  it is computed inside the consumer loops and never allocated;
+* **reduce epilogue** — a reduction absorbs its fusible producers (XLA
+  input fusion) but its own output materializes (the reduce is a group
+  root, conservative w.r.t. further loop-fusion of the reduced value);
+* **fusion barriers** — ``dot_general`` / ``conv`` / collectives /
+  ``custom_call``-ish ops / sort / gather / scatter / RNG and every
+  control-flow or call boundary (``scan``/``while``/``cond``/``pjit``/
+  ``shard_map``) neither fuse as producers nor absorb operands: anything
+  they touch materializes. Unknown primitives are barriers by default;
+* **duplicate-cheap-producers** — a cheap producer with more than one
+  absorbing consumer is duplicated into each consumer's group, but only up
+  to ``max_fanout`` consumers (the fusion-merger's duplication limit);
+  **expensive** elementwise ops (``exp``/``div``/``tanh``/…, XLA's
+  ``IsExpensive`` set) are never duplicated — they fuse only when they
+  have exactly one consumer;
+* **output seams** — a jaxpr output always materializes (it must be
+  written to HBM — and under donation it is the write into the donated
+  storage). An output that *also* has absorbing consumers is tagged
+  ``output-seam``: the forced write splits what would otherwise be one
+  fused chain (the ``hbm-unfused-chain`` rule reports large ones).
+
+Consumers: ``mem_lint.timeline_from_jaxpr(..., fusion=True)`` zeroes
+fused-away buffers on the timeline, ``shard_lint``'s fusion-aware
+``comm_fraction`` denominator counts only materialized bytes, and the
+``hbm-unfused-chain`` registry rule surfaces chains the simulator predicts
+XLA will NOT fuse (broken by a host callback, opaque custom call, or an
+output/donation seam).
+"""
+from __future__ import annotations
+
+__all__ = [
+    "CHEAP_ELEMENTWISE",
+    "EXPENSIVE_ELEMENTWISE",
+    "SHAPE_PRIMS",
+    "REDUCE_EPILOGUE",
+    "OPAQUE_BARRIERS",
+    "MAX_FANOUT",
+    "FusionPlan",
+    "plan_jaxpr",
+    "is_fusible",
+    "is_absorbing",
+]
+
+#: duplication limit: a cheap producer fuses into at most this many
+#: consumer groups before the simulator says XLA materializes it instead.
+#: XLA's fusion-merger will happily duplicate a cheap producer into a
+#: handful of consumers, but whether it actually does depends on
+#: cost-model internals this simulator cannot see — and a wrong "elided"
+#: guess breaks the timeline's upper-bound contract. The default is
+#: therefore the conservative **1** (no duplication: multi-consumer
+#: values materialize); raise it for exploratory what-if analysis. The
+#: measured-zoo crosscheck (tools/mem_lint.py --measure) certifies the
+#: default against ``compiled.memory_analysis()``.
+MAX_FANOUT = 1
+
+#: cheap elementwise primitives — fuse, and duplicate into up to
+#: MAX_FANOUT consumers (XLA ``!IsExpensive``)
+CHEAP_ELEMENTWISE = frozenset({
+    "add", "add_any", "sub", "mul", "neg", "abs", "sign", "square",
+    "floor", "ceil", "round", "clamp", "max", "min",
+    "and", "or", "xor", "not",
+    "eq", "ne", "lt", "le", "gt", "ge",
+    "select_n", "convert_element_type",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "is_finite", "nextafter", "real", "imag", "conj", "complex",
+    "population_count", "clz", "copy", "reduce_precision",
+    "stop_gradient",
+})
+
+#: expensive elementwise primitives — fuse into a single consumer but are
+#: never duplicated (XLA ``IsExpensive``)
+EXPENSIVE_ELEMENTWISE = frozenset({
+    "div", "rem", "pow", "integer_pow", "sqrt", "rsqrt", "cbrt",
+    "exp", "exp2", "expm1", "log", "log1p", "logistic",
+    "tanh", "tan", "sin", "cos", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "asinh", "acosh", "atanh",
+    "erf", "erfc", "erf_inv", "lgamma", "digamma",
+    "igamma", "igammac", "regularized_incomplete_beta",
+    "bessel_i0e", "bessel_i1e",
+})
+
+#: shape/layout primitives — free in a fused loop body (index arithmetic),
+#: duplicated like cheap ops. ``iota`` is a pure producer.
+SHAPE_PRIMS = frozenset({
+    "broadcast_in_dim", "transpose", "reshape", "squeeze", "expand_dims",
+    "rev", "slice", "pad", "iota",
+})
+
+#: reductions absorb fusible producers (input fusion) but root the group:
+#: their own outputs materialize
+REDUCE_EPILOGUE = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "reduce",
+})
+
+#: barrier primitives that are opaque to XLA fusion for *structural*
+#: reasons (host round-trips, custom kernels, explicit barriers) — the
+#: interesting subset for the ``hbm-unfused-chain`` rule: a chain these
+#: break is a chain the USER can often repair (move the callback out of
+#: the hot loop, split the custom call)
+OPAQUE_BARRIERS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "debug_print", "custom_call", "pallas_call", "tpu_custom_call",
+    "triton_call", "outfeed", "infeed", "optimization_barrier",
+})
+
+
+def is_fusible(prim_name):
+    """Can an op with this primitive be computed inside a consumer's fused
+    loop body (i.e. can its output be elided)?"""
+    return (prim_name in CHEAP_ELEMENTWISE
+            or prim_name in EXPENSIVE_ELEMENTWISE
+            or prim_name in SHAPE_PRIMS)
+
+
+def is_absorbing(prim_name):
+    """Can an op with this primitive absorb a fusible operand (compute it
+    in its own loop) — fusible ops and reduce-epilogue roots. Everything
+    else (dot/conv/collectives/control flow/unknown) is a barrier."""
+    return is_fusible(prim_name) or prim_name in REDUCE_EPILOGUE
+
+
+def _is_literal(v):
+    return hasattr(v, "val")
+
+
+def _is_drop(v):
+    return type(v).__name__ == "DropVar"
+
+
+class FusionPlan:
+    """The fusion verdict for one (sub)jaxpr.
+
+    Attributes:
+        group: list aligned with ``jaxpr.eqns`` — the fusion-group id of
+            each equation (equations sharing an id are simulated as one
+            XLA fusion computation; duplicated cheap producers carry the
+            id of the first group they joined).
+        n_groups: number of distinct groups (≤ ``len(eqns)``; the gap is
+            the number of fused edges).
+        n_fused: values classified fused-away.
+
+    Queries: :meth:`is_fused` (buffer elided?), :meth:`reason` (why a
+    fusible-producer value materializes: ``"output"`` / ``"output-seam"``
+    / ``"barrier:<prim>"`` / ``"fanout:<n>"`` / ``"expensive-fanout:<n>"``
+    / ``"dead"`` — empty string for fused or non-fusible producers).
+    """
+
+    def __init__(self, jaxpr, max_fanout=MAX_FANOUT):
+        self.jaxpr = jaxpr
+        self.max_fanout = int(max_fanout)
+        self._fused = {}        # var -> consumer prim it fuses into (doc)
+        self._reasons = {}      # var -> why a fusible output materialized
+        self.group = []
+        self.n_groups = 0
+        self._build()
+
+    # -- queries -------------------------------------------------------------
+    def is_fused(self, v):
+        """True when the plan certifies XLA elides ``v``'s buffer."""
+        if _is_literal(v):
+            return False
+        return v in self._fused
+
+    def reason(self, v):
+        """Why a fusible-producer value materializes ('' when fused, or
+        when the producer was never fusible to begin with)."""
+        if _is_literal(v):
+            return ""
+        return self._reasons.get(v, "")
+
+    @property
+    def n_fused(self):
+        return len(self._fused)
+
+    def as_dict(self):
+        return {
+            "n_eqns": len(self.group),
+            "n_groups": self.n_groups,
+            "n_fused": self.n_fused,
+            "max_fanout": self.max_fanout,
+            "reasons": sorted(set(self._reasons.values())),
+        }
+
+    def __repr__(self):
+        return (f"FusionPlan({len(self.group)} eqns → {self.n_groups} "
+                f"groups, {self.n_fused} fused-away)")
+
+    # -- construction --------------------------------------------------------
+    def _build(self):
+        jaxpr = self.jaxpr
+        eqns = list(jaxpr.eqns)
+        n = len(eqns)
+        self.group = list(range(n))
+        if n == 0:
+            self.n_groups = 0
+            return
+
+        # consumer map: var -> [eqn index] (one entry per consuming eqn,
+        # deduped — a*a has ONE consumer)
+        consumers = {}
+        for i, eqn in enumerate(eqns):
+            seen = set()
+            for v in eqn.invars:
+                if _is_literal(v) or v in seen:
+                    continue
+                seen.add(v)
+                consumers.setdefault(v, []).append(i)
+        outvars = set(v for v in jaxpr.outvars if not _is_literal(v))
+
+        # union-find over eqn indices → fusion groups
+        parent = self.group
+
+        def find(i):
+            while parent[i] != i:
+                parent[i] = parent[parent[i]]
+                i = parent[i]
+            return i
+
+        def union(a, b):
+            ra, rb = find(a), find(b)
+            if ra != rb:
+                parent[max(ra, rb)] = min(ra, rb)
+
+        for i, eqn in enumerate(eqns):
+            prim = eqn.primitive.name
+            if not is_fusible(prim):
+                continue
+            for v in eqn.outvars:
+                if _is_drop(v):
+                    continue
+                cons = consumers.get(v, ())
+                if v in outvars:
+                    self._reasons[v] = (
+                        "output-seam"
+                        if any(is_absorbing(eqns[c].primitive.name)
+                               for c in cons) else "output")
+                    continue
+                if not cons:
+                    self._reasons[v] = "dead"
+                    continue
+                blocker = next(
+                    (c for c in cons
+                     if not is_absorbing(eqns[c].primitive.name)), None)
+                if blocker is not None:
+                    bprim = eqns[blocker].primitive.name
+                    # prefer naming an opaque barrier when one is present:
+                    # that is the actionable consumer
+                    for c in cons:
+                        if eqns[c].primitive.name in OPAQUE_BARRIERS:
+                            bprim = eqns[c].primitive.name
+                            break
+                    self._reasons[v] = f"barrier:{bprim}"
+                    continue
+                if len(cons) > 1:
+                    if prim in EXPENSIVE_ELEMENTWISE:
+                        self._reasons[v] = f"expensive-fanout:{len(cons)}"
+                        continue
+                    if len(cons) > self.max_fanout:
+                        self._reasons[v] = f"fanout:{len(cons)}"
+                        continue
+                # fused away: producer lives inside every consumer's loop
+                self._fused[v] = eqns[cons[0]].primitive.name
+                for c in cons:
+                    union(i, c)
+
+        self.n_groups = len({find(i) for i in range(n)})
+        self.group = [find(i) for i in range(n)]
+
+
+def plan_jaxpr(jaxpr, max_fanout=MAX_FANOUT):
+    """Build the :class:`FusionPlan` for one (sub)jaxpr.
+
+    Accepts an open ``Jaxpr`` or a ``ClosedJaxpr``. The plan is local to
+    this jaxpr's equations: call/control-flow sub-bodies get their own
+    plans (fusion never crosses those boundaries — conservative: XLA may
+    inline-then-fuse across ``pjit``, this simulator does not claim it).
+    """
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    return FusionPlan(inner, max_fanout=max_fanout)

@@ -23,11 +23,17 @@ provenance. The walk honors
 
 Accuracy contract (crosschecked in :func:`.crosscheck.crosscheck_mem`
 against ``compiled.memory_analysis()``): the prediction is an *upper
-bound*. XLA's fusion pass elides temporaries the jaxpr materializes
-(arxiv 2301.13062) and the BFC allocator packs lifetimes tighter than the
-per-eqn granularity here — the timeline must therefore never UNDER-predict
-the compiled peak beyond the rtol gate, while modest over-prediction is
-expected and safe for capacity planning.
+bound*. With ``fusion=True`` (the default) the walk consults
+:mod:`.fusion`'s conservative simulation of XLA's producer-consumer
+fusion (arxiv 2301.13062) and drops only the temporaries the plan
+certifies XLA elides — a fused-away buffer contributes zero bytes, and
+the *sources* a fused chain reads stay live through the chain's consumers
+so the sweep can't under-count mid-chain. ``fusion=False`` restores the
+fusion-blind legacy timeline (looser bound, ``MEM_RTOL_UNFUSED``). The
+BFC allocator still packs lifetimes tighter than the per-eqn granularity
+here — the timeline must therefore never UNDER-predict the compiled peak
+beyond the rtol gate, while modest over-prediction is expected and safe
+for capacity planning.
 
 Consumers: the ``hbm-*`` registry rules (:mod:`.rules`), the serving
 tier's bytes-based admission policy
@@ -38,6 +44,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import fusion as fusion_sim
 from . import shard_lint
 from .shard_lint import (
     _CALL_PRIMS,
@@ -71,6 +78,9 @@ MEM_LINT_DEFAULTS = {
     "spike_min_bytes": 1 << 20,     # …and absolute floor (skip toy programs)
     "kv_waste_fraction": 0.25,      # hbm-kv-bucket-waste padding threshold
     "mem_top_k": 8,                 # contributors listed in reports/findings
+    "fusion": True,                 # fusion-aware timeline (False → legacy)
+    "fusion_max_fanout": fusion_sim.MAX_FANOUT,
+    "unfused_chain_min_bytes": 1 << 20,  # hbm-unfused-chain size floor
 }
 
 
@@ -114,11 +124,16 @@ class BufferLife:
     ``birth``/``death`` are step indices (inclusive; ``birth=-1`` means
     resident from program entry). ``aliases`` names the donated input key
     whose storage this (output) buffer reuses — an aliased buffer
-    contributes zero *new* bytes to the live set."""
+    contributes zero *new* bytes to the live set. ``fused`` marks a
+    temporary the fusion plan certifies XLA elides (computed inside its
+    consumer's loop — also zero bytes); ``unfused_reason`` records why a
+    fusible-producer value materialized anyway (``barrier:<prim>`` /
+    ``output-seam`` / ``fanout:<n>`` — the ``hbm-unfused-chain`` rule's
+    input)."""
 
     __slots__ = ("key", "nbytes", "kind", "path", "where", "shape", "dtype",
                  "donated", "birth", "last_use", "death", "is_output",
-                 "aliases", "tag")
+                 "aliases", "tag", "fused", "unfused_reason")
 
     def __init__(self, key, nbytes, kind="temp", path="", where="",
                  shape=(), dtype="", donated=False, birth=-1, tag=""):
@@ -136,10 +151,13 @@ class BufferLife:
         self.is_output = False
         self.aliases = None         # key of the donated input it reuses
         self.tag = tag              # "" | "scan-slice" | "scan-ys" | "residual"
+        self.fused = False          # fusion plan says XLA elides this buffer
+        self.unfused_reason = ""    # why a fusible value materialized
 
     @property
     def eff_bytes(self):
-        return 0.0 if self.aliases is not None else self.nbytes
+        return 0.0 if (self.aliases is not None or self.fused) \
+            else self.nbytes
 
     def as_dict(self):
         return {"kind": self.kind, "path": self.path, "where": self.where,
@@ -147,7 +165,8 @@ class BufferLife:
                 "nbytes": self.nbytes, "birth": self.birth,
                 "death": self.death, "donated": self.donated,
                 "is_output": self.is_output, "tag": self.tag,
-                "aliases": self.aliases}
+                "aliases": self.aliases, "fused": self.fused,
+                "unfused_reason": self.unfused_reason}
 
     def __repr__(self):
         loc = self.path or self.where
@@ -179,6 +198,8 @@ class MemoryTimeline:
         self.const_bytes = 0.0
         self.donated_bytes = 0.0
         self.alias_bytes = 0.0
+        self.fusion = False         # walked with the fusion plan applied
+        self.fused_bytes = 0.0      # bytes the plan elided from the live set
 
     # -- construction (used by the walker) -----------------------------------
     def step(self, prim, where):
@@ -294,6 +315,8 @@ class MemoryTimeline:
                 self.const_bytes += b.nbytes
             if b.is_output:
                 self.output_bytes += b.nbytes
+            if b.fused:
+                self.fused_bytes += b.nbytes
             if 0 <= b.birth < len(self.step_alloc) and b.eff_bytes > 0:
                 self.step_alloc[b.birth] += b.eff_bytes
         return self
@@ -340,7 +363,8 @@ class MemoryTimeline:
         override, relive = {}, {}
         for key in keys:
             b = self.buffers[int(key)]
-            if b.kind != "temp" or b.is_output or b.aliases is not None:
+            if b.kind != "temp" or b.is_output or b.aliases is not None \
+                    or b.fused:  # fused: zero real bytes — nothing to buy
                 continue
             override[b.key] = max(b.birth, 0)
             relive[b.key] = max(b.last_use, b.birth, 0)
@@ -355,7 +379,8 @@ class MemoryTimeline:
         n = max(len(self.steps), 1)
         out = []
         for b in self.buffers:
-            if b.kind != "temp" or b.is_output or b.aliases is not None:
+            if b.kind != "temp" or b.is_output or b.aliases is not None \
+                    or b.fused:  # never remat a buffer XLA already elides
                 continue
             if b.nbytes < min_bytes:
                 continue
@@ -390,6 +415,8 @@ class MemoryTimeline:
             "const_bytes": self.const_bytes,
             "donated_bytes": self.donated_bytes,
             "alias_bytes": self.alias_bytes,
+            "fusion": self.fusion,
+            "fused_bytes": self.fused_bytes,
             "axis_sizes": dict(self.axis_sizes),
             "contributors": [b.as_dict() for b in self.contributors(top_k)],
         }
@@ -407,6 +434,9 @@ class MemoryTimeline:
         if self.alias_bytes:
             lines.append(f"  donation aliasing reuses "
                          f"{_fmt_bytes(self.alias_bytes)}")
+        if self.fused_bytes:
+            lines.append(f"  fusion elides "
+                         f"{_fmt_bytes(self.fused_bytes)} of temporaries")
         rows = self.contributors(top_k)
         if rows:
             lines.append(f"  {'kind':<7} {'bytes':>12} {'% peak':>7}  "
@@ -436,11 +466,34 @@ class _MemWalker:
     propagation surprise degrades to replicated — i.e. FULL logical bytes,
     which can only over-predict (the safe direction)."""
 
-    def __init__(self, sizes, tl):
+    def __init__(self, sizes, tl, fusion=False, fusion_max_fanout=None):
         self.sizes = dict(sizes or {})
         self.tl = tl
+        self.fusion = bool(fusion)
+        self.fusion_max_fanout = int(
+            fusion_max_fanout if fusion_max_fanout is not None
+            else fusion_sim.MAX_FANOUT)
+        self._plans = {}       # id(jaxpr) -> FusionPlan (plan keeps jaxpr)
+        # fused var -> materialized buffer keys its chain reads: a fused
+        # kernel reads those SOURCES at every consumer step, so their
+        # lifetimes must extend through the chain (else the sweep would
+        # under-count mid-chain — the unsound direction)
+        self._fused_srcs = {}
         self._sw = shard_lint._Walker(
             self.sizes, ShardingAnalysis(axis_order=self.sizes))
+
+    def _plan_for(self, jaxpr):
+        if not self.fusion:
+            return None
+        plan = self._plans.get(id(jaxpr))
+        if plan is None:
+            try:
+                plan = fusion_sim.plan_jaxpr(
+                    jaxpr, max_fanout=self.fusion_max_fanout)
+            except Exception:   # degrade to fusion-blind: over-predicts
+                plan = False
+            self._plans[id(jaxpr)] = plan
+        return plan or None
 
     # -- var helpers ---------------------------------------------------------
     @staticmethod
@@ -532,6 +585,7 @@ class _MemWalker:
     def walk(self, jaxpr, env, spec_env):
         from .graph_lint import _eqn_where
 
+        plan = self._plan_for(jaxpr)
         for eqn in jaxpr.eqns:
             prim = eqn.primitive.name
             where = _eqn_where(eqn)
@@ -544,21 +598,45 @@ class _MemWalker:
             elif prim in _CALL_PRIMS:
                 self._call(eqn, where, env, spec_env)
             else:
-                self._eqn(eqn, where, env, spec_env)
+                self._eqn(eqn, where, env, spec_env, plan=plan)
 
-    def _eqn(self, eqn, where, env, spec_env, tag=""):
+    def _eqn(self, eqn, where, env, spec_env, tag="", plan=None):
         ins = [self.spec_of(v, spec_env) for v in eqn.invars]
         i = self.tl.step(eqn.primitive.name, where)
         for v in eqn.invars:
             k = self._key_of(v, env)
             if k is not None:
                 self.tl.use(k, i)
+            srcs = (self._fused_srcs.get(v)
+                    if not hasattr(v, "val") else None)
+            if srcs:  # the fused chain feeding v is re-read here
+                for sk in srcs:
+                    self.tl.use(sk, i)
         outs = self._out_specs(eqn, ins, where)
         if outs is None:
             outs = [tuple(_R for _ in getattr(v.aval, "shape", ()))
                     for v in eqn.outvars]
         for v, sp in zip(eqn.outvars, outs):
-            self._def_out(v, sp, i, where, env, spec_env, tag=tag)
+            key = self._def_out(v, sp, i, where, env, spec_env, tag=tag)
+            if plan is None or self._is_drop(v):
+                continue
+            if plan.is_fused(v):
+                self.tl.buffers[key].fused = True
+                srcs = set()
+                for u in eqn.invars:
+                    if hasattr(u, "val"):
+                        continue
+                    if u in self._fused_srcs:
+                        srcs.update(self._fused_srcs[u])
+                    else:
+                        uk = self._key_of(u, env)
+                        if uk is not None:
+                            srcs.add(uk)
+                self._fused_srcs[v] = srcs
+            else:
+                reason = plan.reason(v)
+                if reason and reason not in ("output", "dead"):
+                    self.tl.buffers[key].unfused_reason = reason
         return i
 
     def _alias_in(self, sv, ov, env, spec_env):
@@ -731,7 +809,8 @@ class _MemWalker:
 # ---------------------------------------------------------------------------
 def timeline_from_jaxpr(closed_jaxpr, in_specs=None, axis_sizes=None,
                         const_specs=None, donated=None, in_paths=None,
-                        out_paths=None, name=""):
+                        out_paths=None, name="", fusion=True,
+                        fusion_max_fanout=None):
     """Liveness analysis over a raw closed jaxpr (the auto-parallel
     planner's entry — no :class:`StepGraph` required).
 
@@ -744,13 +823,19 @@ def timeline_from_jaxpr(closed_jaxpr, in_specs=None, axis_sizes=None,
             ``.sharding`` when it carries one).
         donated: per-invar donation flags.
         in_paths / out_paths: provenance labels for inputs / outputs.
+        fusion: consult the :mod:`.fusion` plan and drop temporaries it
+            certifies XLA elides (default). ``False`` → the fusion-blind
+            legacy timeline (looser upper bound).
+        fusion_max_fanout: duplication limit forwarded to the plan.
 
     Returns a finalized :class:`MemoryTimeline`.
     """
     jaxpr = closed_jaxpr.jaxpr
     sizes = dict(axis_sizes or {})
     tl = MemoryTimeline(name=name, sizes=sizes)
-    walker = _MemWalker(sizes, tl)
+    tl.fusion = bool(fusion)
+    walker = _MemWalker(sizes, tl, fusion=fusion,
+                        fusion_max_fanout=fusion_max_fanout)
     env, spec_env = {}, {}
 
     in_specs = list(in_specs or ())
@@ -804,13 +889,17 @@ def timeline_from_jaxpr(closed_jaxpr, in_specs=None, axis_sizes=None,
 
 
 def analyze_memory(graph_or_step, *args, mesh=None, in_shardings=None,
-                   sharding=None, config=None, **kwargs):
+                   sharding=None, config=None, fusion=None, **kwargs):
     """Build the :class:`MemoryTimeline` for a step.
 
     Accepts either an already-traced :class:`~.graph_lint.StepGraph` (as
     ``lint_step`` wires it — reusing ``graph.sharding`` for LOCAL shapes)
     or a ``CompiledStep``/callable plus its example batch, which is traced
     abstractly first (no device execution either way).
+
+    ``fusion=None`` (default) resolves from the graph's lint config
+    (``MEM_LINT_DEFAULTS["fusion"]`` → True); pass ``False`` for the
+    fusion-blind legacy timeline.
     """
     from .graph_lint import StepGraph, trace_step
 
@@ -818,6 +907,12 @@ def analyze_memory(graph_or_step, *args, mesh=None, in_shardings=None,
         graph = graph_or_step
     else:
         graph = trace_step(graph_or_step, *args, config=config, **kwargs)
+
+    cfg = dict(getattr(graph, "config", None) or {})
+    if fusion is None:
+        fusion = bool(cfg.get("fusion", MEM_LINT_DEFAULTS["fusion"]))
+    max_fanout = cfg.get("fusion_max_fanout",
+                         MEM_LINT_DEFAULTS["fusion_max_fanout"])
 
     sa = sharding if sharding is not None else getattr(graph, "sharding",
                                                        None)
@@ -853,4 +948,4 @@ def analyze_memory(graph_or_step, *args, mesh=None, in_shardings=None,
     return timeline_from_jaxpr(
         graph.closed_jaxpr, in_specs=in_specs, axis_sizes=sizes,
         donated=flags, in_paths=in_paths, out_paths=out_paths,
-        name=graph.name)
+        name=graph.name, fusion=fusion, fusion_max_fanout=max_fanout)

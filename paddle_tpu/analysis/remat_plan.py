@@ -25,6 +25,13 @@ Wire-up: ``hapi.Model.prepare(remat="auto" | budget_bytes)`` and
 ``distributed.auto_parallel.Engine(remat=...)`` call :func:`auto_remat`
 lazily against the first real batch (the same one-shot hook the graph
 autolint uses), so the remat decision sees the true shapes.
+
+Fusion interaction (ISSUE 18): the timeline is fusion-aware by default,
+and both :meth:`~.mem_lint.MemoryTimeline.delta_if_remat` and the
+``long_lived`` candidate sweep skip buffers the fusion plan marked
+fused-away — a buffer XLA never materializes is worth exactly zero to
+checkpoint, so the planner can no longer "buy back" phantom bytes that
+inflate its predicted savings.
 """
 from __future__ import annotations
 

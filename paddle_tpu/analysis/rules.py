@@ -803,6 +803,77 @@ def _hbm_liveness_spike(graph):
     )
 
 
+@register_rule(
+    "hbm-unfused-chain", "warning",
+    "an elementwise chain the fusion simulator predicts XLA will NOT fuse "
+    "materializes a large temporary")
+def _hbm_unfused_chain(graph):
+    """The fusion plan (:mod:`.fusion`) normally elides elementwise
+    temporaries — this rule surfaces the big ones it could NOT certify:
+    a chain split by an opaque barrier (host callback / custom call —
+    XLA cannot see through it), by an output/donation seam (the value is
+    written to HBM as a program output — under donation, into the donated
+    storage — yet also consumed mid-chain), or by a fanout past the
+    duplication limit. Each is a buffer the user can often win back by
+    restructuring; the fused neighbours cost nothing."""
+    tl = _timeline_of(graph)
+    if tl is None or not getattr(tl, "fusion", False):
+        return
+    floor = graph.config.get("unfused_chain_min_bytes", 1 << 20)
+    from .fusion import OPAQUE_BARRIERS
+
+    rows = []
+    for b in tl.buffers:
+        r = getattr(b, "unfused_reason", "")
+        if not r or b.eff_bytes < floor:
+            continue
+        if r.startswith("barrier:"):
+            if r.split(":", 1)[1] not in OPAQUE_BARRIERS:
+                continue  # feeding a dot/conv/reduce is normal, not a bug
+        elif r == "output-seam":
+            pass
+        elif r.startswith("fanout:"):
+            pass
+        else:  # expensive-fanout etc.: expected XLA behavior, not a chain
+            continue
+        rows.append(b)
+    rows.sort(key=lambda b: -b.nbytes)
+    for b in rows[:4]:
+        r = b.unfused_reason
+        if r.startswith("barrier:"):
+            prim = r.split(":", 1)[1]
+            why = (f"its consumer `{prim}` is opaque to XLA fusion — the "
+                   "chain is forced through HBM at the boundary")
+            hint = (f"move the `{prim}` out of the hot chain (hoist the "
+                    "host round-trip / custom call before or after the "
+                    "fused region), or accept the materialization")
+        elif r == "output-seam":
+            why = ("it is a program output consumed mid-chain — the HBM "
+                   "write (the donation-alias target when state is "
+                   "donated) splits what would otherwise fuse")
+            hint = ("if the output is only needed for logging, compute it "
+                    "from the final values instead of mid-chain; "
+                    "otherwise this write is the price of returning it")
+        else:  # fanout:<n>
+            n = r.split(":", 1)[1]
+            why = (f"it feeds {n} consumers — past the duplication limit, "
+                   "XLA materializes instead of recomputing per consumer")
+            hint = ("restructure so fewer fusion groups read the value, "
+                    "or accept the materialization (recompute would cost "
+                    f"{n}x the producer FLOPs)")
+        yield Finding(
+            rule="hbm-unfused-chain",
+            severity="warning",
+            message=f"{b.dtype}{list(b.shape)} ({_fmt_mib(b.nbytes)}) "
+                    f"materializes although its producer chain is "
+                    f"fusible: {why}",
+            where=b.where,
+            hint=hint,
+            data={"nbytes": b.nbytes, "reason": r, "birth": b.birth,
+                  "death": b.death, "key": b.key},
+        )
+
+
 def _arg_prefix(path):
     import re
 

@@ -269,9 +269,24 @@ _CALL_PRIMS = ("pjit", "closed_call", "core_call", "remat", "remat2",
 
 
 class _Walker:
-    def __init__(self, sizes, ctx):
+    def __init__(self, sizes, ctx, fusion=False):
         self.sizes = sizes      # mesh axis -> size
         self.ctx = ctx          # ShardingAnalysis under construction
+        self.fusion = bool(fusion)
+        self._plans = {}        # id(jaxpr) -> FusionPlan
+
+    def _plan_for(self, jaxpr):
+        if not self.fusion:
+            return None
+        plan = self._plans.get(id(jaxpr))
+        if plan is None:
+            from . import fusion as fusion_sim
+            try:
+                plan = fusion_sim.plan_jaxpr(jaxpr)
+            except Exception:   # degrade: count raw traffic (over-counts)
+                plan = False
+            self._plans[id(jaxpr)] = plan
+        return plan or None
 
     # -- helpers -------------------------------------------------------------
     def _ring(self, op, size):
@@ -309,6 +324,8 @@ class _Walker:
     # -- eqn dispatch --------------------------------------------------------
     def walk(self, jaxpr, env, var_paths, multiplier=1, manual_axes=()):
         from .graph_lint import _eqn_where, _subjaxprs
+
+        plan = self._plan_for(jaxpr)
 
         def spec_of(v):
             aval = getattr(v, "aval", None)
@@ -393,13 +410,27 @@ class _Walker:
 
             # memory-traffic proxy for the comm_fraction denominator: each
             # eqn reads its inputs and writes its outputs once (local
-            # shapes; over-counts vs XLA fusion — documented)
+            # shapes; over-counts vs XLA fusion — documented). The
+            # fusion-aware ``bytes_materialized`` variant skips values the
+            # fusion plan certifies XLA elides (a fused temporary is never
+            # read from or written to HBM), approximating the compiled
+            # program's per-group ``bytes_accessed``.
             if prim not in ("shard_map",) + _CALL_PRIMS:
-                traffic = sum(_local_bytes(v.aval, spec_of(v), self.sizes)
-                              for v in eqn.invars if hasattr(v, "aval"))
-                traffic += sum(_local_bytes(v.aval, env[v], self.sizes)
-                               for v in eqn.outvars)
+                traffic = mat = 0.0
+                for v in eqn.invars:
+                    if not hasattr(v, "aval"):
+                        continue
+                    nb = _local_bytes(v.aval, spec_of(v), self.sizes)
+                    traffic += nb
+                    if plan is None or not plan.is_fused(v):
+                        mat += nb
+                for v in eqn.outvars:
+                    nb = _local_bytes(v.aval, env[v], self.sizes)
+                    traffic += nb
+                    if plan is None or not plan.is_fused(v):
+                        mat += nb
                 self.ctx.bytes_proxy += multiplier * traffic
+                self.ctx.bytes_materialized += multiplier * mat
 
     # -- per-primitive handlers ---------------------------------------------
     def _explicit_collective(self, eqn, ins, where, multiplier):
@@ -788,9 +819,14 @@ class ShardingAnalysis:
         in_specs: ``{input path: spec}`` as propagated from the example
             batch / state shardings.
         bytes_proxy: static memory-traffic proxy (every eqn reads inputs +
-            writes outputs once, local shapes) — the ``comm_fraction``
-            denominator. Over-counts vs XLA's fused ``bytes_accessed``, so
-            the predicted fraction is a floor, not a match, of devprof's.
+            writes outputs once, local shapes). Over-counts vs XLA's fused
+            ``bytes_accessed``.
+        bytes_materialized: the fusion-aware variant — same sweep, but
+            values the :mod:`.fusion` plan certifies XLA elides are
+            skipped (never read from or written to HBM). When the walk
+            ran with ``fusion=True`` this is the ``comm_fraction``
+            denominator, bringing the predicted fraction much closer to
+            devprof's measured one than the raw proxy's floor.
     """
 
     def __init__(self, mesh=None, axis_order=None):
@@ -803,6 +839,8 @@ class ShardingAnalysis:
         self.reshards = []
         self.in_specs = {}
         self.bytes_proxy = 0.0
+        self.bytes_materialized = 0.0
+        self.fusion = False
 
     def _add(self, pc):
         self.predicted.append(pc)
@@ -815,7 +853,8 @@ class ShardingAnalysis:
 
     @property
     def comm_fraction(self):
-        denom = self.comm_bytes + self.bytes_proxy
+        mem = self.bytes_materialized if self.fusion else self.bytes_proxy
+        denom = self.comm_bytes + mem
         return self.comm_bytes / denom if denom > 0 else 0.0
 
     def bytes_by_axis(self):
@@ -828,6 +867,9 @@ class ShardingAnalysis:
             "collectives": self.collectives.as_dict(),
             "comm_bytes": self.comm_bytes,
             "comm_fraction": self.comm_fraction,
+            "bytes_proxy": self.bytes_proxy,
+            "bytes_materialized": self.bytes_materialized,
+            "fusion": self.fusion,
             "predicted": [p.as_dict() for p in self.predicted],
             "reshards": [r.as_dict() for r in self.reshards],
         }
@@ -835,9 +877,14 @@ class ShardingAnalysis:
     def table(self):
         from ..profiler.devprof import _fmt_bytes
 
+        mem = ("mem denominator "
+               f"{_fmt_bytes(self.bytes_materialized)} materialized"
+               if self.fusion else
+               "mem denominator "
+               f"{_fmt_bytes(self.bytes_proxy)} proxy (fusion off)")
         lines = [f"shard lint — predicted collectives "
                  f"({_fmt_bytes(self.comm_bytes)} moved/device, "
-                 f"comm_fraction {self.comm_fraction:.4f})"]
+                 f"comm_fraction {self.comm_fraction:.4f}, {mem})"]
         if not self.collectives:
             lines.append("  none (replicated program or single device)")
         for axis in self.collectives.axes():
@@ -874,15 +921,18 @@ def _graph_invar_leaves(graph):
 
 
 def propagate_jaxpr(closed_jaxpr, in_specs, axis_sizes, const_specs=None,
-                    mesh=None, in_paths=None):
+                    mesh=None, in_paths=None, fusion=True):
     """Run the propagation over ``closed_jaxpr`` with explicit per-invar
     specs. ``in_specs``: one spec per ``jaxpr.invars`` entry;
     ``const_specs``: per ``jaxpr.constvars``. Returns the
     :class:`ShardingAnalysis`. This is the raw engine —
     :func:`analyze_sharding` derives the specs from a traced step's
-    array shardings for you."""
+    array shardings for you. ``fusion=True`` (default) makes
+    ``comm_fraction`` use the fusion-aware materialized-bytes
+    denominator; ``False`` restores the raw-traffic proxy."""
     sizes = {str(a): int(s) for a, s in dict(axis_sizes).items()}
     ctx = ShardingAnalysis(mesh=mesh, axis_order=sizes)
+    ctx.fusion = bool(fusion)
     jaxpr = closed_jaxpr.jaxpr
     env = {}
     var_paths = {}
@@ -899,12 +949,12 @@ def propagate_jaxpr(closed_jaxpr, in_specs, axis_sizes, const_specs=None,
               and i < len(const_specs) else ())
         sp = sp[:nd] + tuple(_R for _ in range(nd - len(sp)))
         env[v] = _dedupe_axes(sp)
-    _Walker(sizes, ctx).walk(jaxpr, env, var_paths)
+    _Walker(sizes, ctx, fusion=fusion).walk(jaxpr, env, var_paths)
     return ctx
 
 
 def analyze_sharding(graph_or_step, *args, mesh=None, in_shardings=None,
-                     **kwargs):
+                     fusion=None, **kwargs):
     """Abstract sharding propagation for a step.
 
     Args:
@@ -915,6 +965,10 @@ def analyze_sharding(graph_or_step, *args, mesh=None, in_shardings=None,
             leaves when omitted. No mesh (or size 1) → returns None.
         in_shardings: optional ``{input path: PartitionSpec-like}``
             overrides applied on top of the leaf-derived specs.
+        fusion: fusion-aware ``comm_fraction`` denominator (see
+            :func:`propagate_jaxpr`). ``None`` (default) reads the
+            graph's ``config["fusion"]`` — same knob as mem_lint — and
+            falls back to True.
 
     Returns:
         :class:`ShardingAnalysis` or None when no multi-device mesh is in
@@ -926,6 +980,8 @@ def analyze_sharding(graph_or_step, *args, mesh=None, in_shardings=None,
         graph = graph_or_step
     else:
         graph = trace_step(graph_or_step, *args, **kwargs)
+    if fusion is None:
+        fusion = bool(getattr(graph, "config", {}).get("fusion", True))
 
     rows = _graph_invar_leaves(graph)
     if mesh is None:
@@ -954,7 +1010,7 @@ def analyze_sharding(graph_or_step, *args, mesh=None, in_shardings=None,
 
     sa = propagate_jaxpr(graph.closed_jaxpr, in_specs, sizes,
                          const_specs=const_specs, mesh=mesh,
-                         in_paths=in_paths)
+                         in_paths=in_paths, fusion=fusion)
     sa.in_specs = dict(zip(in_paths, in_specs))
     return sa
 

@@ -607,7 +607,11 @@ class GenerationEngine:
         Returns a dict:
 
         * ``decode_peak_bytes`` — predicted live-set peak of one batched
-          ``serve_decode`` dispatch (cache + weights + activations);
+          ``serve_decode`` dispatch (cache + weights + activations).
+          Fusion-aware since ISSUE 18: elementwise decode temporaries
+          the :mod:`~paddle_tpu.analysis.fusion` plan certifies XLA
+          elides are not priced, so admission headroom is no longer
+          eaten by phantom activation bytes;
         * ``cache_bytes`` — the static KV cache allocation;
         * ``base_bytes`` — everything but the cache (weights, decode
           temps): resident whether or not any request is active;

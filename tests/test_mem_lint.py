@@ -6,8 +6,9 @@ CLI exports.
 
 Acceptance (ISSUE 12):
   * on the dp×mp zoo config and the gpt2 serve decode the predicted peak
-    agrees with ``compiled.memory_analysis()`` within rtol=0.15 on
-    XLA:CPU and never UNDER-predicts beyond the rtol;
+    agrees with ``compiled.memory_analysis()`` within the MEM_RTOL band
+    (0.15 at ISSUE 12; 0.10 + 64 KiB atol since the fusion-aware
+    timeline of ISSUE 18) on XLA:CPU and never UNDER-predicts beyond it;
   * ``tools/mem_lint.py --fixture undonated-longctx`` exits 1;
   * the bytes-based ``CostAwareAdmission`` sheds a request at submit that
     the token-count policy would have admitted straight into an
@@ -194,9 +195,13 @@ def test_zero_sharded_update_cuts_predicted_peak(cli):
     n_params = 256 * 1024 + 1024 + 1024 * 256 + 256  # the zoo MLP
     acc_drop = 12 * n_params * (dp - 1) // dp
     drop = tl_plain.peak_bytes - tl_zero.peak_bytes
-    # at least the accumulator shards leave the peak; the ceiling admits
-    # the sharded gradients/update temps that ride along (~1.43x observed)
-    assert drop >= acc_drop, (drop, acc_drop)
+    # essentially the accumulator shards leave the peak. The floor admits
+    # the fusion-aware timeline (ISSUE 18) eliding a few hundred KB of
+    # update temps from the PLAIN peak that the fusion-blind model priced
+    # on top of the accumulators (0.95x observed); the ceiling admits the
+    # sharded gradients/update temps that ride along on the legacy path
+    # (~1.43x observed with fusion off)
+    assert drop >= 0.9 * acc_drop, (drop, acc_drop)
     assert drop <= 1.6 * acc_drop, (drop, acc_drop)
 
 
@@ -344,12 +349,27 @@ def test_timeline_table_and_dict():
 # ---------------------------------------------------------------------------
 
 def test_crosscheck_mem_verdicts():
-    ok = analysis.crosscheck_mem(100.0, {"peak_bytes": 100.0})[0]
+    m = float(100 << 20)  # well above MEM_ATOL so rtol dominates
+    ok = analysis.crosscheck_mem(m, {"peak_bytes": m})[0]
     assert ok["agrees"] is True and ok["under_predicted"] is False
-    under = analysis.crosscheck_mem(50.0, {"peak_bytes": 100.0})[0]
+    under = analysis.crosscheck_mem(0.5 * m, {"peak_bytes": m})[0]
     assert under["agrees"] is False and under["under_predicted"] is True
-    over = analysis.crosscheck_mem(200.0, {"peak_bytes": 100.0})[0]
+    over = analysis.crosscheck_mem(2.0 * m, {"peak_bytes": m})[0]
     assert over["agrees"] is False and over["under_predicted"] is False
+
+
+def test_crosscheck_mem_atol_floor():
+    """ISSUE 18: tiny programs carry a fixed runtime-scratch overhead no
+    live-set model predicts — the MEM_ATOL absolute band absorbs it, so a
+    KB-scale gap never flips the verdict, while MB-scale gaps still do."""
+    assert analysis.MEM_ATOL == 64 << 10
+    small = analysis.crosscheck_mem(
+        100.0, {"peak_bytes": float(analysis.MEM_ATOL)})[0]
+    assert small["agrees"] is True and small["under_predicted"] is False
+    # zero atol restores the strict relative verdict
+    strict = analysis.crosscheck_mem(
+        100.0, {"peak_bytes": float(analysis.MEM_ATOL)}, atol=0.0)[0]
+    assert strict["agrees"] is False and strict["under_predicted"] is True
 
 
 def test_crosscheck_mem_skips_alias_unavailable():

@@ -193,11 +193,13 @@ def _build_dp_adam(zero):
                    NamedSharding(mesh, P("dp", None))))
     y = Tensor(put(jnp.asarray(rng.randn(64, 256), jnp.bfloat16),
                    NamedSharding(mesh, P("dp", None))))
-    # static-only: the pair exists for the PREDICTED optimizer-state
-    # accounting (tests/test_mem_lint.py pins the dp-fold peak drop); the
-    # step is optimizer-temp dominated, where the fusion-blind upper-bound
-    # model legitimately over-predicts XLA's fused update kernel
-    return step, (x, y), mesh, False
+    # measurable since ISSUE 18: the step is optimizer-temp dominated —
+    # exactly where the fusion-blind model over-predicted XLA's fused
+    # update kernel — and the fusion-aware timeline (analysis.fusion)
+    # elides those elementwise temporaries, so the predicted peak now
+    # crosschecks against memory_analysis within MEM_RTOL (the pinned
+    # dp-fold peak drop in tests/test_mem_lint.py rides on top)
+    return step, (x, y), mesh, True
 
 
 def build_dp_plain(fixture=None):
@@ -343,6 +345,31 @@ def run_remat_fixture(capacity=None, out=sys.stdout):
     return 0 if ok else 1
 
 
+def run_fusion_ab(out=sys.stdout):
+    """``--fixture fusion-ab``: the fusion on/off A/B gate. The SAME
+    optimizer-temp-dominated step (dp-plain) is walked twice; the
+    fusion-aware timeline must (a) certify a non-trivial byte volume as
+    elided, (b) predict a strictly lower-or-equal peak, and (c) never go
+    below the step's irreducible floor (donated state bytes — fusion can
+    elide temporaries, not parameters). Returns 0 on success."""
+    from paddle_tpu import analysis
+
+    step, batch, _, _ = build_dp_plain()
+    tl_on = analysis.analyze_memory(step, *batch, fusion=True)
+    tl_off = analysis.analyze_memory(step, *batch, fusion=False)
+    floor = tl_on.donated_bytes
+    delta = tl_off.peak_bytes - tl_on.peak_bytes
+    ok = (tl_on.fused_bytes > 0
+          and tl_on.peak_bytes <= tl_off.peak_bytes
+          and tl_on.peak_bytes >= floor)
+    print(f"\n== fusion A/B ({step.name}) ==", file=out)
+    print(f"fusion off peak {tl_off.peak_bytes:.0f} B, on "
+          f"{tl_on.peak_bytes:.0f} B (delta {delta:.0f} B, "
+          f"{tl_on.fused_bytes:.0f} B of temporaries elided, state floor "
+          f"{floor:.0f} B) -> {'OK' if ok else 'FAIL'}", file=out)
+    return 0 if ok else 1
+
+
 ZOO = {
     "dp-mp": build_dp_mp,
     "serve-decode": build_serve_decode,
@@ -355,16 +382,19 @@ ZOO = {
 FIXTURES = {
     "undonated-longctx": build_undonated_longctx,
     "remat-plan": run_remat_fixture,  # special-cased: a planner gate
+    "fusion-ab": run_fusion_ab,       # special-cased: fusion on/off A/B
 }
 
 
 def lint_zoo(models, fixture=None, measure=False, capacity=None,
-             out=sys.stdout):
+             out=sys.stdout, fusion=True):
     """Returns ``[(name, LintReport, MemoryTimeline, crosscheck_rows)]``
-    (import-friendly: the tests drive this directly)."""
+    (import-friendly: the tests drive this directly). ``fusion=False``
+    runs the fusion-blind legacy timeline (looser upper bound — the A/B
+    smoke leg compares both)."""
     from paddle_tpu import analysis
 
-    config = {}
+    config = {"fusion": bool(fusion)}
     if capacity is not None:
         config["hbm_capacity_bytes"] = float(capacity)
     builders = (
@@ -387,7 +417,9 @@ def lint_zoo(models, fixture=None, measure=False, capacity=None,
             from paddle_tpu.profiler import devprof
 
             rep = devprof.device_report(step, *batch, register=False)
-            rows = analysis.crosscheck_mem(tl, rep)
+            rtol = (analysis.MEM_RTOL if fusion
+                    else analysis.MEM_RTOL_UNFUSED)
+            rows = analysis.crosscheck_mem(tl, rep, rtol=rtol)
             for r in rows:
                 ratio = ("n/a" if r["ratio"] is None
                          else f"{r['ratio']:.3f}")
@@ -437,6 +469,11 @@ def run(argv=None):
                          "disable_blockwise_attention flag) — the "
                          "run_tests.sh long-context gate lints the SAME "
                          "config both ways under one --capacity")
+    ap.add_argument("--no-fusion", action="store_true",
+                    help="run the fusion-blind legacy timeline (looser "
+                         "upper bound, crosschecked at MEM_RTOL_UNFUSED "
+                         "instead of MEM_RTOL) — the --smoke A/B leg "
+                         "compares both")
     ap.add_argument("--smoke", action="store_true",
                     help="CI gate: clean zoo with --measure must pass, the "
                          "undonated fixture must exit 1, the longctx config "
@@ -454,12 +491,14 @@ def run(argv=None):
         es = run(["--models", "longctx", "--capacity",
                   str(LONGCTX_CAPACITY), "--disable-blockwise"])
         remat = run(["--fixture", "remat-plan"])
+        ab = run(["--fixture", "fusion-ab"])
         ok = (clean == 0 and fixture == 1 and bw == 0 and es == 1
-              and remat == 0)
+              and remat == 0 and ab == 0)
         print(f"\nmem lint smoke: clean-zoo rc={clean} (want 0), "
               f"fixture rc={fixture} (want 1), longctx-blockwise rc={bw} "
               f"(want 0), longctx-einsum rc={es} (want 1), remat-plan "
-              f"rc={remat} (want 0) -> {'OK' if ok else 'FAIL'}")
+              f"rc={remat} (want 0), fusion-ab rc={ab} (want 0) -> "
+              f"{'OK' if ok else 'FAIL'}")
         return 0 if ok else 1
 
     if args.disable_blockwise:
@@ -470,12 +509,15 @@ def run(argv=None):
     capacity = args.capacity
     if args.fixture == "remat-plan":
         return run_remat_fixture(capacity)
+    if args.fixture == "fusion-ab":
+        return run_fusion_ab()
     if args.fixture and capacity is None:
         capacity = FIXTURE_CAPACITY
 
     sink = open(os.devnull, "w") if args.format == "sarif" else sys.stdout
     results = lint_zoo(args.models, fixture=args.fixture,
-                       measure=args.measure, capacity=capacity, out=sink)
+                       measure=args.measure, capacity=capacity, out=sink,
+                       fusion=not args.no_fusion)
 
     if args.format == "sarif":
         from paddle_tpu.analysis import sarif_report
@@ -496,9 +538,13 @@ def run(argv=None):
 
     n_err = sum(len(r.errors) for _, r, _, _ in results)
     n_warn = sum(len(r.warnings) for _, r, _, _ in results)
+    # fusion-aware timelines must agree both ways; the legacy --no-fusion
+    # path over-predicts by design (fusion-blindness is its documented
+    # bias), so only under-prediction gates there
+    fusion_on = not getattr(args, "no_fusion", False)
     bad_cross = sum(
         1 for _, _, _, rows in results for r in (rows or ())
-        if r["agrees"] is False or r["under_predicted"])
+        if r["under_predicted"] or (fusion_on and r["agrees"] is False))
     print(f"\nmem lint: {n_err} error(s), {n_warn} warning(s), "
           f"{bad_cross} crosscheck disagreement(s) across "
           f"{len(results)} config(s)", file=sink)

@@ -142,16 +142,21 @@ PYEOF
       --fixture mismatched-constraint > /dev/null 2>&1; then
     echo "shard_lint missed the mismatched-constraint fixture" >&2; exit 1
   fi
-  # mem-lint gate (ISSUE 12 + 15): per-eqn liveness over the zoo — the
-  # clean configs (incl. the blockwise longctx train step and the
-  # chunked-prefill serving step) must lint with zero errors AND the
-  # predicted HBM peak must agree with compiled.memory_analysis() within
-  # rtol (--measure, never under-predicting); the undonated long-context
-  # fixture MUST be flagged over its injected budget (exit 1); the
-  # longctx config must FIT a synthetic capacity that the einsum path
-  # (--disable-blockwise) must BLOW on the same shapes; and the
+  # mem-lint gate (ISSUE 12 + 15 + 18): fusion-aware per-eqn liveness
+  # over the zoo — the clean configs (incl. the blockwise longctx train
+  # step, the chunked-prefill serving step, and the now-measurable
+  # dp-plain/dp-zero steps) must lint with zero errors AND the predicted
+  # HBM peak must agree with compiled.memory_analysis() within the
+  # ratcheted MEM_RTOL=0.10 (+64 KiB atol) band (--measure, never
+  # under-predicting beyond it); the undonated long-context fixture MUST
+  # be flagged over its injected budget (exit 1); the longctx config
+  # must FIT a synthetic capacity that the einsum path
+  # (--disable-blockwise) must BLOW on the same shapes; the
   # selective-remat planner must get the predicted peak under its budget
-  # (--fixture remat-plan, exit 0); --smoke runs every leg
+  # (--fixture remat-plan, exit 0); and the fusion A/B leg
+  # (--fixture fusion-ab) must show the fusion simulation eliding
+  # temporaries without dipping under the donated-state floor;
+  # --smoke runs every leg
   JAX_PLATFORMS=cpu python tools/mem_lint.py --smoke
   # ZeRO dp-parity gate (ISSUE 14): the dp=2 sharded-update smoke bench
   # must hold loss parity against replicated Adam (--parity asserts it),

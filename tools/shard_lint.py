@@ -374,15 +374,19 @@ ZOO = {
 }
 
 
-def lint_zoo(models, fixture=None, measure=False, out=sys.stdout):
+def lint_zoo(models, fixture=None, measure=False, out=sys.stdout,
+             fusion=True):
     """Returns ``[(name, LintReport, ShardingAnalysis, crosscheck_rows)]``
-    (import-friendly: the tests drive this directly)."""
+    (import-friendly: the tests drive this directly). ``fusion`` toggles
+    the fusion-aware ``comm_fraction`` denominator (materialized bytes
+    instead of the raw all-intermediates proxy)."""
     from paddle_tpu import analysis
 
     results = []
     for name in models:
         step, batch, mesh, measurable = ZOO[name](fixture=fixture)
-        report = analysis.lint_step(step, *batch, mesh=mesh)
+        report = analysis.lint_step(step, *batch, mesh=mesh,
+                                    config={"fusion": bool(fusion)})
         sa = report.sharding  # the propagation lint_step ran
         print(f"\n== {name} ({step.name}) ==", file=out)
         print(report.table(), file=out)
@@ -429,6 +433,10 @@ def main(argv=None):
     ap.add_argument("--measure", action="store_true",
                     help="also compile measurable configs via devprof and "
                          "print the predicted-vs-HLO crosscheck")
+    ap.add_argument("--no-fusion", action="store_true",
+                    help="disable the fusion simulation: comm_fraction "
+                         "falls back to the raw all-intermediates bytes "
+                         "proxy (pre-ISSUE-18 behavior)")
     ap.add_argument("--fail-on", default="error",
                     choices=["error", "warning", "never"],
                     help="exit 1 when findings at/above this severity "
@@ -437,7 +445,8 @@ def main(argv=None):
 
     sink = open(os.devnull, "w") if args.format == "sarif" else sys.stdout
     results = lint_zoo(args.models, fixture=args.fixture,
-                       measure=args.measure, out=sink)
+                       measure=args.measure, out=sink,
+                       fusion=not args.no_fusion)
 
     if args.format == "sarif":
         from paddle_tpu.analysis import sarif_report
